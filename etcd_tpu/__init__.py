@@ -1,0 +1,18 @@
+"""etcd_tpu: a TPU-native distributed KV framework with etcd's capabilities.
+
+The consensus core is a batched multi-Raft engine: thousands of independent
+Raft groups packed into structure-of-arrays tensors and stepped in lockstep
+by JAX/XLA kernels (see ``etcd_tpu.batched``), with a reference-semantics
+host core (``etcd_tpu.raft``) that replays the upstream etcd
+``raft/testdata`` interaction traces with exact parity and serves as the
+control plane for rare transitions (membership changes, snapshots).
+
+Layer map (mirrors the reference's, SURVEY.md §1):
+  - ``etcd_tpu.raft``     — consensus state machine (ref: raft/)
+  - ``etcd_tpu.batched``  — SoA multi-group TPU engine (the north star)
+  - ``etcd_tpu.rafttest`` — datadriven interaction-trace harness (ref: raft/rafttest)
+  - ``etcd_tpu.storage``  — WAL / snapshots / MVCC (ref: server/storage)
+  - ``etcd_tpu.server``   — replicated KV server (ref: server/etcdserver)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""Data-driven interaction-trace harness (ref: raft/rafttest/).
+
+Replays the reference's ``raft/testdata/*.txt`` traces against the
+etcd_tpu consensus core and compares output byte-for-byte — the parity
+oracle named by the north star.
+"""
+
+from .datadriven import TestData, CmdArg, parse_file, run_file  # noqa: F401
+from .interaction import InteractionEnv, RedirectLogger  # noqa: F401

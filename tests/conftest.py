@@ -1,0 +1,21 @@
+"""Test configuration.
+
+Sharding/distributed tests run on a virtual 8-device CPU mesh: real
+multi-chip TPU hardware is not available in CI, and XLA's
+host-platform-device-count flag gives us N independent devices with the
+same SPMD semantics. Must be set before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE_ROOT = "/root/reference"

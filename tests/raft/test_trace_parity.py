@@ -1,0 +1,32 @@
+"""Replay the reference's raft/testdata interaction traces bit-for-bit.
+
+This is the north-star parity oracle (BASELINE.md): every directive in
+every trace file must produce byte-identical output from our consensus
+core. ref: raft/interaction_test.go:24-38.
+"""
+
+import glob
+import os
+
+import pytest
+
+from etcd_tpu.rafttest import InteractionEnv, run_file
+
+TESTDATA = "/root/reference/raft/testdata"
+
+trace_files = sorted(glob.glob(os.path.join(TESTDATA, "*.txt")))
+
+
+@pytest.mark.skipif(not trace_files, reason="reference testdata not available")
+@pytest.mark.parametrize("path", trace_files, ids=[os.path.basename(p) for p in trace_files])
+def test_trace_parity(path):
+    env = InteractionEnv()
+    failures = [
+        f"--- {d.pos}: {d.cmd} {' '.join(a.key for a in d.cmd_args)}\n"
+        f"expected:\n{d.expected}\n"
+        f"actual:\n{actual}\n"
+        for d, actual in run_file(path, env.handle)
+    ]
+    assert not failures, f"{len(failures)} mismatching directives:\n" + "\n".join(
+        failures[:5]
+    )
