@@ -1,0 +1,84 @@
+"""Benchmark: Raft groups stepped per second on one chip.
+
+Runs the batched multi-Raft engine closed-loop (deliver → tick →
+propose → emit → route, all on device) with every group leader-elected
+and a steady proposal load, and measures group-rounds per wall-second.
+
+One group-step = one group of R replicas processing a full message round
+(R*K inbox slots each, commit-quorum reduction included). The north-star
+target (BASELINE.md) is ≥1M groups stepped/sec/chip; `vs_baseline` is
+value / 1e6 against that target. For calibration, the reference's
+headline single-group figure is 10k writes/sec (ref: README.md:21).
+
+Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
+
+    platform = jax.devices()[0].platform
+    groups = 65536 if platform == "tpu" else 512
+    rounds_per_call = 16
+    cfg = BatchedConfig(
+        num_groups=groups,
+        num_replicas=3,
+        window=32,
+        max_ents_per_msg=4,
+        max_props_per_round=2,
+        election_timeout=1 << 20,  # steady state: no timer elections
+        heartbeat_timeout=4,
+        auto_compact=True,  # sustained load: ring chases the applied mark
+    )
+    eng = MultiRaftEngine(cfg)
+
+    # Elect slot 0 of every group, settle.
+    eng.campaign([g * cfg.num_replicas for g in range(groups)])
+    eng.run_rounds(4, tick=False)
+    leaders = eng.leaders()
+    assert (leaders == 0).all(), "election failed in bench setup"
+
+    # Steady-state load: every leader appends 2 entries per round.
+    props = jnp.zeros((cfg.num_instances,), jnp.int32)
+    props = props.at[jnp.arange(groups) * cfg.num_replicas].set(2)
+
+    # Warmup (compile).
+    eng.run_rounds(rounds_per_call, tick=True, propose_n=props)
+    jax.block_until_ready(eng.state.commit)
+
+    # Timed.
+    t0 = time.perf_counter()
+    calls = 8
+    for _ in range(calls):
+        eng.run_rounds(rounds_per_call, tick=True, propose_n=props)
+    jax.block_until_ready(eng.state.commit)
+    dt = time.perf_counter() - t0
+
+    total_group_rounds = groups * rounds_per_call * calls
+    rate = total_group_rounds / dt
+
+    # Sanity: commits advanced during the timed window.
+    commits = eng.commits()
+    assert commits.min() > 0
+
+    print(
+        json.dumps(
+            {
+                "metric": "raft_groups_stepped_per_sec",
+                "value": round(rate, 1),
+                "unit": f"group-rounds/s ({platform}, G={groups}, R=3)",
+                "vs_baseline": round(rate / 1e6, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

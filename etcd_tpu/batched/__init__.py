@@ -1,0 +1,26 @@
+"""Batched multi-Raft TPU engine — the north star (BASELINE.json).
+
+Thousands to a million independent Raft groups are packed into
+structure-of-arrays tensors and stepped in lockstep by one jitted XLA
+program:
+
+- ``state``:   per-replica-instance SoA state ``[N, ...]`` where
+               ``N = groups × replicas`` (instance ``i`` is replica
+               ``i % R`` of group ``i // R``); log tails are ``[N, W]``
+               term rings; leader progress is ``[N, R]``.
+- ``kernels``: the replica-axis reductions (quorum commit index as an
+               order statistic, vote tallies as masked sums) and log-ring
+               primitives, differentially tested against the scalar
+               oracles in ``etcd_tpu.raft``.
+- ``step``:    the vmapped, branch-free message handlers (ref:
+               raft/raft.go stepLeader/stepFollower/stepCandidate) +
+               tick/propose/emit phases and the all-device message router
+               (a transpose over the dense (group, replica) layout).
+- ``engine``:  the host-facing MultiRaftEngine with the
+               HasReady → Ready → persist → send → Advance contract of
+               ``raft.RawNode``, batched over all groups.
+"""
+
+from .state import BatchedConfig, BatchedState, init_state  # noqa: F401
+from .step import make_step_round  # noqa: F401
+from .engine import MultiRaftEngine  # noqa: F401

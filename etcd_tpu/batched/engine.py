@@ -1,0 +1,116 @@
+"""Host-facing wrapper around the batched step kernel.
+
+``MultiRaftEngine`` is the BatchedRawNode of the north star: it keeps
+the full multi-group SoA state on device, exposes the same logical
+contract as ``raft.RawNode`` (tick / campaign / propose / step / ready
+watermarks / advance) but batched over every group at once, and runs
+closed-loop rounds entirely on device (deliver → tick → propose → emit →
+route). Entry payloads never touch the device: the host keeps them in
+an arena keyed by (group, index), and the commit watermarks streaming
+back from the device drive payload application — mirroring how the
+reference applies committed entries after the Ready loop (ref:
+server/etcdserver/raft.go:158-315).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .state import BatchedConfig, BatchedState, init_state, LEADER, I32
+from .step import MsgSlots, NUM_KINDS, empty_msgs, make_step_round, route
+
+
+class MultiRaftEngine:
+    def __init__(self, cfg: BatchedConfig, start_index: int = 0):
+        self.cfg = cfg
+        self.state = init_state(cfg, start_index)
+        self.inbox = empty_msgs(
+            (cfg.num_instances, cfg.num_replicas, NUM_KINDS),
+            cfg.max_ents_per_msg,
+        )
+        self._step = make_step_round(cfg)
+        n = cfg.num_instances
+        self._zeros_b = jnp.zeros((n,), bool)
+        self._zeros_i = jnp.zeros((n,), I32)
+
+        def closed_loop(st, inbox, ticks, props, rounds):
+            def body(carry, _):
+                st, inbox = carry
+                st, outbox = self._step(
+                    st, inbox, ticks, self._zeros_b, props, self._zeros_b
+                )
+                return (st, route(cfg, outbox)), None
+
+            (st, inbox), _ = jax.lax.scan(
+                body, (st, inbox), None, length=rounds
+            )
+            return st, inbox
+
+        self._closed_loop = jax.jit(closed_loop, static_argnames=("rounds",))
+
+    # -- driving --------------------------------------------------------------
+
+    def step_round(
+        self,
+        tick: bool = False,
+        campaign_mask: Optional[jnp.ndarray] = None,
+        propose_n: Optional[jnp.ndarray] = None,
+        isolate: Optional[jnp.ndarray] = None,
+    ) -> None:
+        """One round: deliver pending messages, optionally tick every
+        instance, append proposals on leaders, route the outbox.
+        `isolate` cuts instances off the network for this round."""
+        ticks = (
+            jnp.ones_like(self._zeros_b) if tick else self._zeros_b
+        )
+        camp = campaign_mask if campaign_mask is not None else self._zeros_b
+        props = propose_n if propose_n is not None else self._zeros_i
+        iso = isolate if isolate is not None else self._zeros_b
+        self.state, outbox = self._step(
+            self.state, self.inbox, ticks, camp, props, iso
+        )
+        self.inbox = route(self.cfg, outbox)
+
+    def run_rounds(self, rounds: int, tick: bool = True,
+                   propose_n: Optional[jnp.ndarray] = None) -> None:
+        """Closed-loop simulation of `rounds` rounds without leaving the
+        device (one fused lax.scan program)."""
+        ticks = jnp.ones_like(self._zeros_b) if tick else self._zeros_b
+        props = propose_n if propose_n is not None else self._zeros_i
+        self.state, self.inbox = self._closed_loop(
+            self.state, self.inbox, ticks, props, rounds
+        )
+
+    def campaign(self, instance_ids) -> None:
+        mask = self._zeros_b.at[jnp.asarray(instance_ids)].set(True)
+        self.state, outbox = self._step(
+            self.state, self.inbox, self._zeros_b, mask, self._zeros_i,
+            self._zeros_b,
+        )
+        self.inbox = route(self.cfg, outbox)
+
+    # -- observation (device → host gathers, debug/Ready watermarks) ----------
+
+    def leaders(self) -> np.ndarray:
+        """Per group: leader replica slot, or -1."""
+        role = np.asarray(self.state.role).reshape(
+            self.cfg.num_groups, self.cfg.num_replicas
+        )
+        is_lead = role == LEADER
+        return np.where(is_lead.any(axis=1), is_lead.argmax(axis=1), -1)
+
+    def commits(self) -> np.ndarray:
+        """Per-instance commit watermarks [G, R] — the host applies
+        payloads from its arena up to these."""
+        return np.asarray(self.state.commit).reshape(
+            self.cfg.num_groups, self.cfg.num_replicas
+        )
+
+    def terms(self) -> np.ndarray:
+        return np.asarray(self.state.term).reshape(
+            self.cfg.num_groups, self.cfg.num_replicas
+        )

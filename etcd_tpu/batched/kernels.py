@@ -1,0 +1,116 @@
+"""Replica-axis and log-ring kernels for the batched engine.
+
+These are the array forms of the scalar oracles in ``etcd_tpu.raft``:
+  - quorum_committed   ↔ quorum.MajorityConfig.committed_index
+                         (ref: raft/quorum/majority.go:126-172)
+  - vote_result        ↔ quorum.MajorityConfig.vote_result
+                         (ref: raft/quorum/majority.go:178-210)
+  - term_at            ↔ raftLog.term (ref: raft/log.go:268-288)
+  - find_conflict_by_term ↔ raftLog.findConflictByTerm
+                         (ref: raft/log.go:150-171) — exploits that log
+                         terms are nondecreasing in the index, so the
+                         backward scan becomes a masked count.
+
+All functions are written per-instance (scalars + [R]/[W] vectors) and
+are used under vmap over the instance axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+I32 = jnp.int32
+MAX_I32 = 2**31 - 1  # plain int: keep module import free of backend init
+
+VOTE_PENDING, VOTE_LOST, VOTE_WON = 1, 2, 3
+
+
+def quorum_committed(match: jnp.ndarray, voter: jnp.ndarray) -> jnp.ndarray:
+    """Largest index acked by a quorum of voters.
+
+    Go picks srt[n-(n/2+1)] of the ascending sort of n acked indexes
+    (missing voters count 0). Masking non-voters to 0 prepends (R-n)
+    zeros to the sort, shifting the pick to position R - n//2 - 1.
+    """
+    r = match.shape[-1]
+    n = jnp.sum(voter.astype(I32))
+    masked = jnp.where(voter, match, 0)
+    srt = jnp.sort(masked)  # ascending
+    pos = jnp.clip(r - n // 2 - 1, 0, r - 1)
+    # Empty config commits "everything" (joint-quorum convention).
+    return jnp.where(n == 0, MAX_I32, srt[pos])
+
+
+def vote_result(votes: jnp.ndarray, voter: jnp.ndarray) -> jnp.ndarray:
+    """VOTE_WON / VOTE_LOST / VOTE_PENDING from a [R] vote vector
+    (-1 missing / 0 rejected / 1 granted) and a voter mask."""
+    n = jnp.sum(voter.astype(I32))
+    yes = jnp.sum((voter & (votes == 1)).astype(I32))
+    no = jnp.sum((voter & (votes == 0)).astype(I32))
+    missing = n - yes - no
+    q = n // 2 + 1
+    won = (yes >= q) | (n == 0)
+    pending = yes + missing >= q
+    return jnp.where(won, VOTE_WON, jnp.where(pending, VOTE_PENDING, VOTE_LOST))
+
+
+def term_at(
+    log_term: jnp.ndarray,
+    snap_index: jnp.ndarray,
+    snap_term: jnp.ndarray,
+    last: jnp.ndarray,
+    i: jnp.ndarray,
+) -> jnp.ndarray:
+    """Term of entry i; 0 outside [snap_index, last] (the reference's
+    "zero term on compacted/unavailable" behavior)."""
+    w = log_term.shape[-1]
+    in_ring = (i > snap_index) & (i <= last)
+    ring_val = log_term[jnp.clip(i, 0, None) % w]
+    return jnp.where(
+        i == snap_index, snap_term, jnp.where(in_ring, ring_val, 0)
+    )
+
+
+def find_conflict_by_term(
+    log_term: jnp.ndarray,
+    snap_index: jnp.ndarray,
+    snap_term: jnp.ndarray,
+    last: jnp.ndarray,
+    index: jnp.ndarray,
+    term: jnp.ndarray,
+) -> jnp.ndarray:
+    """Largest idx <= index with term_at(idx) <= term.
+
+    Log terms never decrease with index, so the answer is
+    snap_index + |{ j in (snap_index, min(index,last)] : term(j) <= term }|.
+    Degenerates to snap_index (the dummy index) when nothing matches,
+    like the reference's backward scan hitting ErrCompacted.
+    """
+    w = log_term.shape[-1]
+    hi = jnp.minimum(index, last)
+    j = jnp.arange(w, dtype=I32)
+    idx = snap_index + 1 + j
+    valid = idx <= hi
+    terms = log_term[idx % w]
+    cnt = jnp.sum((valid & (terms <= term)).astype(I32))
+    # When nothing in the window matches, the reference's backward walk
+    # stops at the dummy index (term = snap_term) or, if even that term
+    # is too large, one below it (term() reports 0 below the dummy —
+    # ref: log.go:268-274).
+    floor = jnp.where(snap_term <= term, snap_index, snap_index - 1)
+    return jnp.where(cnt > 0, snap_index + cnt, floor)
+
+
+def ring_write(
+    log_term: jnp.ndarray, start_index: jnp.ndarray, terms: jnp.ndarray,
+    count: jnp.ndarray,
+) -> jnp.ndarray:
+    """Write `count` terms at log positions start_index..start_index+count-1
+    into the [W] ring."""
+    w = log_term.shape[-1]
+    k = terms.shape[-1]
+    j = jnp.arange(k, dtype=I32)
+    pos = (start_index + j) % w
+    mask = j < count
+    cur = log_term[pos]
+    return log_term.at[pos].set(jnp.where(mask, terms, cur))

@@ -1,0 +1,184 @@
+"""Host shadow cluster: the single-group oracle driven under the batched
+engine's round/slot network semantics, for lockstep differential testing.
+
+The batched engine's network delivers at most one message of each KIND
+per (sender, target) pair per round and processes inbox slots in a fixed
+(sender, kind) order. This adapter runs R reference-semantics RawNodes
+(etcd_tpu.raft) under exactly those rules so that, for schedules within
+the common feature envelope (explicit campaigns, leader-side proposals,
+heartbeat ticks, full-instance partitions; no timer elections), the
+device state must match the oracle state field-for-field after every
+round. Schedules that would overflow a slot (two same-kind messages to
+one target in one round) raise, keeping the comparison honest.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..raft import Config, MemoryStorage, RawNode
+from ..raft.errors import RaftError
+from ..raft.types import ConfState, Message, MessageType
+from .step import (
+    KIND_APP,
+    KIND_APP_RESP,
+    KIND_HB,
+    KIND_HB_RESP,
+    KIND_VOTE,
+    KIND_VOTE_RESP,
+    NUM_KINDS,
+)
+
+# Kind lanes, matching step.py's inbox layout.
+_TYPE_TO_KIND = {
+    MessageType.MsgVote: KIND_VOTE,
+    MessageType.MsgPreVote: KIND_VOTE,
+    MessageType.MsgApp: KIND_APP,
+    MessageType.MsgSnap: KIND_APP,
+    MessageType.MsgHeartbeat: KIND_HB,
+    MessageType.MsgVoteResp: KIND_VOTE_RESP,
+    MessageType.MsgPreVoteResp: KIND_VOTE_RESP,
+    MessageType.MsgAppResp: KIND_APP_RESP,
+    MessageType.MsgHeartbeatResp: KIND_HB_RESP,
+}
+
+
+class ShadowCluster:
+    def __init__(
+        self,
+        num_replicas: int,
+        election_timeout: int = 1 << 20,
+        heartbeat_timeout: int = 1,
+        max_inflight: int = 1 << 20,
+        pre_vote: bool = False,
+    ):
+        self.r = num_replicas
+        self.nodes: List[RawNode] = []
+        for slot in range(num_replicas):
+            storage = MemoryStorage()
+            # Bootstrap the full-voter config the way the batched engine
+            # does: membership is initial state, not replayed conf changes.
+            storage._snapshot.metadata.conf_state = ConfState(
+                voters=list(range(1, num_replicas + 1))
+            )
+            cfg = Config(
+                id=slot + 1,
+                election_tick=election_timeout,
+                heartbeat_tick=heartbeat_timeout,
+                storage=storage,
+                max_size_per_msg=1 << 62,
+                max_inflight_msgs=max_inflight,
+                pre_vote=pre_vote,
+            )
+            self.nodes.append(RawNode(cfg))
+        # inbox[target][sender][kind]
+        self.inbox: List[List[List[Optional[Message]]]] = self._empty_inbox()
+
+    def _empty_inbox(self):
+        return [
+            [[None] * NUM_KINDS for _ in range(self.r)] for _ in range(self.r)
+        ]
+
+    def round(
+        self,
+        campaigns: Sequence[int] = (),
+        proposals: Optional[Dict[int, int]] = None,
+        tick: bool = False,
+        isolate: Iterable[int] = (),
+    ) -> None:
+        """One round with the device's phase order:
+        deliver → tick/campaign → propose → emit."""
+        iso = set(isolate)
+        proposals = proposals or {}
+
+        # Phase 1: deliver, fixed (sender, kind) order per target.
+        inbox, self.inbox = self.inbox, self._empty_inbox()
+        for target in range(self.r):
+            if target in iso:
+                continue
+            for sender in range(self.r):
+                for kind in range(NUM_KINDS):
+                    m = inbox[target][sender][kind]
+                    if m is None:
+                        continue
+                    try:
+                        self.nodes[target].step(m)
+                    except RaftError:
+                        pass
+
+        # Phase 2: tick / explicit campaigns.
+        if tick:
+            for node in self.nodes:
+                node.tick()
+        for slot in campaigns:
+            self.nodes[slot].campaign()
+
+        # Phase 3: proposals (empty payloads; the batched engine carries
+        # payloads in the host arena, so terms are the shared content).
+        # All n entries ride one MsgProp — the batched engine appends
+        # its per-round proposals as one batch with one broadcast.
+        from ..raft.types import Entry
+
+        for slot, n in proposals.items():
+            if n <= 0:
+                continue
+            node = self.nodes[slot]
+            try:
+                node.raft.step(
+                    Message(
+                        type=MessageType.MsgProp,
+                        from_=node.raft.id,
+                        entries=[Entry(data=b"") for _ in range(n)],
+                    )
+                )
+            except RaftError:
+                pass
+
+        # Phase 4: emit — run the Ready loop, bucket outbound messages.
+        for slot, node in enumerate(self.nodes):
+            if not node.has_ready():
+                continue
+            rd = node.ready()
+            storage = node.raft.raft_log.storage
+            if rd.hard_state.term or rd.hard_state.vote or rd.hard_state.commit:
+                storage.set_hard_state(rd.hard_state)
+            storage.append(rd.entries)
+            for m in rd.messages:
+                if slot in iso:
+                    continue
+                kind = _TYPE_TO_KIND.get(m.type)
+                if kind is None:
+                    raise AssertionError(f"unroutable message type {m.type}")
+                target = m.to - 1
+                if self.inbox[target][slot][kind] is not None:
+                    raise AssertionError(
+                        f"slot collision: {m.type} from {slot} to {target}; "
+                        "schedule outside the differential envelope"
+                    )
+                self.inbox[target][slot][kind] = m
+            node.advance(rd)
+
+    # -- state vector for comparison ------------------------------------------
+
+    def snapshot_state(self) -> List[Tuple[int, ...]]:
+        """(term, role, lead, commit, last) per replica — the fields the
+        batched engine must reproduce exactly."""
+        out = []
+        for node in self.nodes:
+            r = node.raft
+            out.append(
+                (
+                    r.term,
+                    int(r.state),
+                    r.lead,
+                    r.raft_log.committed,
+                    r.raft_log.last_index(),
+                )
+            )
+        return out
+
+    def log_terms(self, slot: int) -> List[Tuple[int, int]]:
+        r = self.nodes[slot].raft
+        lo = r.raft_log.first_index()
+        hi = r.raft_log.last_index()
+        return [(i, r.raft_log.term(i)) for i in range(lo, hi + 1)]

@@ -1,0 +1,154 @@
+"""SoA state for the batched multi-Raft engine.
+
+Layout: one *replica instance* per row. Instance ``i`` is replica slot
+``i % R`` of group ``i // R``; the dense layout makes the network router
+a transpose (see step.py). All arrays are int32/bool — terms, indexes
+and counts fit comfortably, and int32 keeps the VPU lanes full.
+
+State fields mirror the reference raft struct (ref: raft/raft.go:243-316)
+and tracker.Progress (ref: raft/tracker/progress.go:30-80), with the
+reference's per-peer maps flattened to ``[N, R]`` and the log flattened
+to a ``[N, W]`` term ring (entry payloads live in the host arena; commit
+decisions only ever touch (term, index), ref: SURVEY.md §7 "payload
+bytes don't belong on the TPU").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# Role encoding (matches etcd_tpu.raft.StateType).
+FOLLOWER, CANDIDATE, LEADER, PRECANDIDATE = 0, 1, 2, 3
+
+# Progress state encoding (matches tracker.ProgressStateType).
+PROBE, REPLICATE, SNAPSHOT = 0, 1, 2
+
+I32 = jnp.int32
+
+
+class BatchedConfig(NamedTuple):
+    """Static (compile-time) engine configuration."""
+
+    num_groups: int
+    num_replicas: int  # R: replica slots per group (<= 8 keeps sorts cheap)
+    window: int  # W: log-ring capacity per instance
+    max_ents_per_msg: int  # E: entries carried by one MsgApp
+    max_props_per_round: int  # P: proposals appended per instance per round
+    election_timeout: int = 10
+    heartbeat_timeout: int = 1
+    max_inflight: int = 256
+    pre_vote: bool = False
+    check_quorum: bool = False
+    # Advance snap_index toward the applied watermark each round,
+    # keeping window//2 entries of tail for follower catch-up; laggards
+    # beyond that take the snapshot path (ref: etcdserver's
+    # SnapshotCount / CatchUpEntries policy, server.go:73,80).
+    auto_compact: bool = False
+
+    @property
+    def num_instances(self) -> int:
+        return self.num_groups * self.num_replicas
+
+
+class BatchedState(NamedTuple):
+    """Per-instance consensus state, all leading dim N = G*R."""
+
+    # HardState + role (ref: raft.go:246-247,259,267)
+    term: jnp.ndarray  # [N] i32
+    vote: jnp.ndarray  # [N] i32, replica slot + 1; 0 = None
+    role: jnp.ndarray  # [N] i32 (FOLLOWER/CANDIDATE/LEADER/PRECANDIDATE)
+    lead: jnp.ndarray  # [N] i32, slot + 1; 0 = None
+
+    # Log (ref: raft/log.go raftLog) — ring of terms plus watermarks.
+    log_term: jnp.ndarray  # [N, W] i32; term of entry i at ring slot i % W
+    snap_index: jnp.ndarray  # [N] i32: index covered by snapshot (= first-1)
+    snap_term: jnp.ndarray  # [N] i32
+    last: jnp.ndarray  # [N] i32: last log index
+    commit: jnp.ndarray  # [N] i32
+    applied: jnp.ndarray  # [N] i32
+
+    # Ticks (ref: raft.go:285-303)
+    election_elapsed: jnp.ndarray  # [N] i32
+    heartbeat_elapsed: jnp.ndarray  # [N] i32
+    randomized_timeout: jnp.ndarray  # [N] i32
+    reset_count: jnp.ndarray  # [N] i32 (drives the deterministic timeout hash)
+
+    # Leader-side per-peer progress (ref: tracker/progress.go)
+    match: jnp.ndarray  # [N, R] i32
+    next: jnp.ndarray  # [N, R] i32
+    pr_state: jnp.ndarray  # [N, R] i32 (PROBE/REPLICATE/SNAPSHOT)
+    probe_sent: jnp.ndarray  # [N, R] bool
+    pending_snapshot: jnp.ndarray  # [N, R] i32
+    recent_active: jnp.ndarray  # [N, R] bool
+    inflight: jnp.ndarray  # [N, R] i32 — count+watermark degeneration of
+    # the reference's ring buffer (ref: SURVEY.md §2.1 Inflights)
+
+    # Votes (ref: tracker.go Votes): -1 not voted, 0 rejected, 1 granted
+    votes: jnp.ndarray  # [N, R] i32
+
+    # Membership: voter mask over replica slots (single majority config;
+    # joint configs add a second mask — ref: quorum/joint.go)
+    voter: jnp.ndarray  # [N, R] bool
+
+    # Pending send flags consumed by the emit phase.
+    send_append: jnp.ndarray  # [N, R] bool
+    send_heartbeat: jnp.ndarray  # [N, R] bool
+    send_vote_req: jnp.ndarray  # [N] bool
+    vote_req_is_pre: jnp.ndarray  # [N] bool
+
+
+def _slot_ids(cfg: BatchedConfig) -> np.ndarray:
+    return np.arange(cfg.num_instances, dtype=np.int32) % cfg.num_replicas
+
+
+def instance_slot(cfg: BatchedConfig) -> jnp.ndarray:
+    """[N] replica slot of each instance (used as `self id - 1`)."""
+    return jnp.asarray(_slot_ids(cfg))
+
+
+def init_state(cfg: BatchedConfig, start_index: int = 0) -> BatchedState:
+    """All groups bootstrapped as followers at term 0 with R voters, log
+    beginning at start_index (mirrors add-nodes bootstrap-from-snapshot,
+    ref: rafttest/interaction_env_handler_add_nodes.go)."""
+    n, r, w = cfg.num_instances, cfg.num_replicas, cfg.window
+    zeros_n = jnp.zeros((n,), I32)
+    start = jnp.full((n,), start_index, I32)
+    st = BatchedState(
+        term=zeros_n,
+        vote=zeros_n,
+        role=jnp.full((n,), FOLLOWER, I32),
+        lead=zeros_n,
+        log_term=jnp.zeros((n, w), I32),
+        snap_index=start,
+        snap_term=jnp.where(start > 0, jnp.ones((n,), I32), zeros_n),
+        last=start,
+        commit=start,
+        applied=start,
+        election_elapsed=zeros_n,
+        heartbeat_elapsed=zeros_n,
+        # Per-instance randomized [et, 2et) from the start (reset_count
+        # 0 of the deterministic hash) — a uniform value would make
+        # every boot election a guaranteed split vote.
+        randomized_timeout=cfg.election_timeout
+        + (
+            (jnp.arange(n, dtype=I32) + 1) * 7919 % cfg.election_timeout
+        ),
+        reset_count=zeros_n,
+        match=jnp.zeros((n, r), I32),
+        next=jnp.ones((n, r), I32) * (start[:, None] + 1),
+        pr_state=jnp.full((n, r), PROBE, I32),
+        probe_sent=jnp.zeros((n, r), bool),
+        pending_snapshot=jnp.zeros((n, r), I32),
+        recent_active=jnp.zeros((n, r), bool),
+        inflight=jnp.zeros((n, r), I32),
+        votes=jnp.full((n, r), -1, I32),
+        voter=jnp.ones((n, r), bool),
+        send_append=jnp.zeros((n, r), bool),
+        send_heartbeat=jnp.zeros((n, r), bool),
+        send_vote_req=jnp.zeros((n,), bool),
+        vote_req_is_pre=jnp.zeros((n,), bool),
+    )
+    return st

@@ -1,0 +1,871 @@
+"""The batched step kernel: branch-free message handlers vmapped over the
+instance axis, with an all-device message router.
+
+Semantics mirror the single-group oracle (etcd_tpu.raft.raft, ref:
+raft/raft.go Step/stepLeader/stepCandidate/stepFollower) for the hot
+path: appends, append responses with reject-hint probing, heartbeats,
+elections (vote + optional pre-vote), commit-index advancement, snapshot
+fallback for lagging followers, and proposals. Cold-path features
+(joint-config membership changes, leader transfer, ReadIndex) run on the
+host via the oracle and are uploaded as new masks — see SURVEY.md §2.1.
+
+Network model: per round each replica sends at most one message of each
+KIND to each peer, so an inbox is a dense ``[N, R, K]`` slot array and
+routing between instances of the same group is a single transpose over
+the (sender, target) axes — no scatters, no host round-trips. A round
+is one jitted program:
+
+    deliver (scan over R*K slots) → tick → propose → emit → route
+
+Determinism: randomized election timeouts use a per-instance hash of
+(instance id, reset count), reproducible by the host oracle for
+differential testing (ref: raft.go:1718-1720 resetRandomizedElectionTimeout).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .kernels import (
+    VOTE_LOST,
+    VOTE_WON,
+    find_conflict_by_term,
+    quorum_committed,
+    ring_write,
+    term_at,
+    vote_result,
+)
+from .state import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    PRECANDIDATE,
+    PROBE,
+    REPLICATE,
+    SNAPSHOT,
+    BatchedConfig,
+    BatchedState,
+    I32,
+)
+
+# Message kinds = inbox slot layout (capacity classes, not semantics: a
+# slot of a response kind may carry a stale-term MsgAppResp; handlers
+# dispatch on the type field). The response to a kind-k request routes
+# back in lane 3+k, so lane 3 carries vote responses, lane 4 append
+# responses, lane 5 heartbeat responses.
+KIND_VOTE, KIND_APP, KIND_HB, KIND_VOTE_RESP, KIND_APP_RESP, KIND_HB_RESP = range(6)
+NUM_KINDS = 6
+
+# Wire types (values match etcd_tpu.raft.types.MessageType).
+T_APP, T_APP_RESP = 3, 4
+T_VOTE, T_VOTE_RESP = 5, 6
+T_SNAP = 7
+T_HB, T_HB_RESP = 8, 9
+T_PREVOTE, T_PREVOTE_RESP = 17, 18
+
+
+class MsgSlots(NamedTuple):
+    """SoA message batch; every field has the same leading shape, plus
+    ent_terms with a trailing [E]."""
+
+    valid: jnp.ndarray  # bool
+    type: jnp.ndarray  # i32
+    term: jnp.ndarray  # i32
+    log_term: jnp.ndarray  # i32
+    index: jnp.ndarray  # i32
+    commit: jnp.ndarray  # i32
+    reject: jnp.ndarray  # bool
+    reject_hint: jnp.ndarray  # i32
+    n_ents: jnp.ndarray  # i32
+    ent_terms: jnp.ndarray  # i32 [..., E]
+
+
+def empty_msgs(shape: Tuple[int, ...], num_ents: int) -> MsgSlots:
+    z = jnp.zeros(shape, I32)
+    return MsgSlots(
+        valid=jnp.zeros(shape, bool),
+        type=z,
+        term=z,
+        log_term=z,
+        index=z,
+        commit=z,
+        reject=jnp.zeros(shape, bool),
+        reject_hint=z,
+        n_ents=z,
+        ent_terms=jnp.zeros(shape + (num_ents,), I32),
+    )
+
+
+def _sel(cond, a, b):
+    """Tree-select: where(cond, a, b) leafwise (cond is scalar here)."""
+    return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)
+
+
+# -----------------------------------------------------------------------------
+# Per-instance primitive transitions (scalars + [R]/[W] vectors; used
+# under vmap). Each returns a full BatchedState slice.
+# -----------------------------------------------------------------------------
+
+
+def _rand_timeout(cfg: BatchedConfig, iid, reset_count):
+    """Deterministic stand-in for lockedRand: [et, 2et-1], reproducible
+    by the host oracle."""
+    h = ((iid + 1) * 7919 + reset_count * 104729) % cfg.election_timeout
+    return cfg.election_timeout + h
+
+
+def _reset(cfg: BatchedConfig, st: BatchedState, iid, slot, term) -> BatchedState:
+    """ref: raft.go:590-619 reset()."""
+    r = st.match.shape[-1]
+    changed = st.term != term
+    rc = st.reset_count + 1
+    peers = jnp.arange(r, dtype=I32)
+    return st._replace(
+        term=term,
+        vote=jnp.where(changed, 0, st.vote),
+        lead=jnp.zeros_like(st.lead),
+        election_elapsed=jnp.zeros_like(st.election_elapsed),
+        heartbeat_elapsed=jnp.zeros_like(st.heartbeat_elapsed),
+        reset_count=rc,
+        randomized_timeout=_rand_timeout(cfg, iid, rc),
+        votes=jnp.full((r,), -1, I32),
+        match=jnp.where(peers == slot, st.last, 0),
+        next=jnp.full((r,), 1, I32) * (st.last + 1),
+        pr_state=jnp.full((r,), PROBE, I32),
+        probe_sent=jnp.zeros((r,), bool),
+        pending_snapshot=jnp.zeros((r,), I32),
+        recent_active=jnp.zeros((r,), bool),
+        inflight=jnp.zeros((r,), I32),
+    )
+
+
+def _become_follower(cfg, st, iid, slot, term, lead) -> BatchedState:
+    st = _reset(cfg, st, iid, slot, term)
+    return st._replace(role=jnp.full_like(st.role, FOLLOWER), lead=lead)
+
+
+def _append_own(cfg: BatchedConfig, st: BatchedState, slot, n) -> BatchedState:
+    """Leader appends n entries of its own term (ref: raft.go:621-642
+    appendEntry): ring write, self progress, maybe_commit."""
+    p = cfg.max_props_per_round
+    terms = jnp.full((p,), 1, I32) * st.term
+    log = ring_write(st.log_term, st.last + 1, terms, n)
+    last = st.last + n
+    r = st.match.shape[-1]
+    peers = jnp.arange(r, dtype=I32)
+    match = jnp.where(peers == slot, jnp.maximum(st.match, last), st.match)
+    nxt = jnp.where(peers == slot, jnp.maximum(st.next, last + 1), st.next)
+    st = st._replace(log_term=log, last=last, match=match, next=nxt)
+    return _maybe_commit(st)
+
+
+def _maybe_commit(st: BatchedState) -> BatchedState:
+    """Quorum commit-index advancement — THE replica-axis reduction
+    (ref: raft.go:585-588 + quorum/majority.go:126)."""
+    mci = quorum_committed(st.match, st.voter)
+    ok = (mci > st.commit) & (
+        term_at(st.log_term, st.snap_index, st.snap_term, st.last, mci) == st.term
+    )
+    return st._replace(commit=jnp.where(ok, mci, st.commit))
+
+
+def _become_leader(cfg, st, iid, slot) -> BatchedState:
+    """ref: raft.go:724-758 (reset, self replicate, append empty entry)."""
+    st = _reset(cfg, st, iid, slot, st.term)
+    r = st.match.shape[-1]
+    peers = jnp.arange(r, dtype=I32)
+    st = st._replace(
+        role=jnp.full_like(st.role, LEADER),
+        lead=slot + 1,
+        pr_state=jnp.where(peers == slot, REPLICATE, st.pr_state),
+    )
+    return _append_own(cfg, st, slot, jnp.asarray(1, I32))
+
+
+def _record_vote_and_tally(st: BatchedState, from_slot, granted):
+    """ref: tracker.go RecordVote (setdefault) + TallyVotes."""
+    r = st.votes.shape[-1]
+    peers = jnp.arange(r, dtype=I32)
+    new_vote = jnp.where(granted, 1, 0)
+    votes = jnp.where(
+        (peers == from_slot) & (st.votes == -1), new_vote, st.votes
+    )
+    st = st._replace(votes=votes)
+    return st, vote_result(votes, st.voter)
+
+
+def _campaign(cfg: BatchedConfig, st: BatchedState, iid, slot, pre) -> BatchedState:
+    """ref: raft.go:785-835; `pre` is a static bool (config.pre_vote)."""
+    if pre:
+        # becomePreCandidate: no term bump, no vote change.
+        st1 = st._replace(
+            role=jnp.full_like(st.role, PRECANDIDATE),
+            lead=jnp.zeros_like(st.lead),
+            votes=jnp.full_like(st.votes, -1),
+        )
+    else:
+        st1 = _reset(cfg, st, iid, slot, st.term + 1)
+        st1 = st1._replace(
+            role=jnp.full_like(st.role, CANDIDATE), vote=slot + 1
+        )
+    st1, res = _record_vote_and_tally(st1, slot, jnp.asarray(True))
+    won = res == VOTE_WON
+    if pre:
+        # Single-voter group: pre-vote win chains into the real election.
+        st_won = _campaign(cfg, st1, iid, slot, False)
+    else:
+        st_won = _become_leader(cfg, st1, iid, slot)
+    st_lost = st1._replace(
+        send_vote_req=jnp.ones_like(st.send_vote_req),
+        vote_req_is_pre=jnp.full_like(st.vote_req_is_pre, pre),
+    )
+    return _sel(won, st_won, st_lost)
+
+
+def _paused(cfg: BatchedConfig, st: BatchedState):
+    """[R] bool — ref: tracker/progress.go:201-212 IsPaused."""
+    return jnp.where(
+        st.pr_state == PROBE,
+        st.probe_sent,
+        jnp.where(
+            st.pr_state == REPLICATE,
+            st.inflight >= cfg.max_inflight,
+            True,
+        ),
+    )
+
+
+# -----------------------------------------------------------------------------
+# Per-message delivery (one inbox slot for one instance)
+# -----------------------------------------------------------------------------
+
+
+def _deliver_one(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
+                 from_slot):
+    """Step one message; returns (state', response MsgSlots scalar-shaped).
+
+    Mirrors raft.Step's term handling then the role step functions
+    (ref: raft.go:847-987, 991-1473)."""
+    no_resp = empty_msgs((), cfg.max_ents_per_msg)
+
+    last_term = term_at(st.log_term, st.snap_index, st.snap_term, st.last, st.last)
+
+    # ---- term handling (ref: raft.go:849-920) ----
+    higher = m.term > st.term
+    lower = m.term < st.term
+
+    from_leader_type = (m.type == T_APP) | (m.type == T_HB) | (m.type == T_SNAP)
+    is_vote_req = (m.type == T_VOTE) | (m.type == T_PREVOTE)
+
+    in_lease = (
+        jnp.asarray(cfg.check_quorum)
+        & (st.lead != 0)
+        & (st.election_elapsed < cfg.election_timeout)
+    )
+    ignore_lease = higher & is_vote_req & in_lease
+
+    keep_term = (m.type == T_PREVOTE) | ((m.type == T_PREVOTE_RESP) & ~m.reject)
+    do_become = higher & ~keep_term & ~ignore_lease
+    st_b = _become_follower(
+        cfg, st, iid, slot, m.term,
+        jnp.where(from_leader_type, from_slot + 1, 0),
+    )
+    st1 = _sel(do_become, st_b, st)
+
+    # Stale-term handling: nudge removed/stale leaders with an empty
+    # MsgAppResp, reject stale pre-votes, ignore the rest.
+    stale_leader_msg = (
+        lower
+        & jnp.asarray(cfg.check_quorum or cfg.pre_vote)
+        & ((m.type == T_HB) | (m.type == T_APP))
+    )
+    stale_prevote = lower & (m.type == T_PREVOTE)
+    # Both stale-path responses carry our (higher) term so the deposed
+    # sender steps down on receipt (the oracle's send() stamps r.Term).
+    resp_stale = no_resp._replace(
+        valid=stale_leader_msg | stale_prevote,
+        type=jnp.where(stale_prevote, T_PREVOTE_RESP, T_APP_RESP),
+        term=st.term,
+        reject=stale_prevote,
+    )
+
+    # ---- main dispatch (on st1, post term handling) ----
+    st_out, resp = _dispatch(cfg, iid, slot, st1, m, from_slot, last_term)
+
+    dead = ~m.valid | ignore_lease
+    st_out = _sel(dead, st, _sel(lower, st, st_out))
+    resp = _sel(
+        dead, no_resp, _sel(lower, resp_stale, resp)
+    )
+    return st_out, resp
+
+
+def _dispatch(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
+              from_slot, last_term):
+    no_resp = empty_msgs((), cfg.max_ents_per_msg)
+    r = st.match.shape[-1]
+    peers = jnp.arange(r, dtype=I32)
+
+    # ---- vote requests, any role (ref: raft.go:930-978) ----
+    is_vote_req = (m.type == T_VOTE) | (m.type == T_PREVOTE)
+    can_vote = (
+        (st.vote == from_slot + 1)
+        | ((st.vote == 0) & (st.lead == 0))
+        | ((m.type == T_PREVOTE) & (m.term > st.term))
+    )
+    up_to_date = (m.log_term > last_term) | (
+        (m.log_term == last_term) & (m.index >= st.last)
+    )
+    grant = can_vote & up_to_date
+    resp_type = jnp.where(m.type == T_VOTE, T_VOTE_RESP, T_PREVOTE_RESP)
+    vote_resp = no_resp._replace(
+        valid=is_vote_req,
+        type=resp_type,
+        term=jnp.where(grant, m.term, st.term),
+        reject=~grant,
+    )
+    record_real = grant & (m.type == T_VOTE)
+    st_vote = st._replace(
+        election_elapsed=jnp.where(record_real, 0, st.election_elapsed),
+        vote=jnp.where(record_real, from_slot + 1, st.vote),
+    )
+
+    # ---- candidate receiving leader traffic at own term steps down
+    # (ref: raft.go:1390-1398) ----
+    is_cand = (st.role == CANDIDATE) | (st.role == PRECANDIDATE)
+    from_leader_type = (m.type == T_APP) | (m.type == T_HB) | (m.type == T_SNAP)
+    st_f = _sel(
+        is_cand & from_leader_type,
+        _become_follower(cfg, st, iid, slot, m.term, from_slot + 1),
+        st,
+    )
+
+    # ---- follower: MsgApp / MsgHeartbeat / MsgSnap (ref: raft.go:1433-1444) ----
+    fol = st_f._replace(
+        election_elapsed=jnp.zeros_like(st.election_elapsed),
+        lead=from_slot + 1,
+    )
+    st_app, app_resp = _handle_append(cfg, fol, m)
+    st_hb = fol._replace(
+        commit=jnp.maximum(fol.commit, jnp.minimum(m.commit, fol.last))
+    )
+    hb_resp = no_resp._replace(
+        valid=True, type=jnp.asarray(T_HB_RESP, I32), term=fol.term
+    )
+    st_snap, snap_resp = _handle_snapshot(cfg, fol, m)
+
+    # Only followers-or-demoted-candidates take the leader-traffic path;
+    # a leader at the same term can't coexist, but mask anyway.
+    leader_traffic_ok = st.role != LEADER
+
+    # ---- leader: MsgAppResp / MsgHeartbeatResp (ref: raft.go:1106-1309) ----
+    st_ar = _leader_app_resp(cfg, st, m, from_slot)
+    st_hr = _leader_hb_resp(cfg, st, m, from_slot)
+    is_leader = st.role == LEADER
+
+    # ---- candidate: vote responses (ref: raft.go:1399-1414) ----
+    my_resp_type = jnp.where(st.role == PRECANDIDATE, T_PREVOTE_RESP, T_VOTE_RESP)
+    st_vr = _candidate_vote_resp(cfg, iid, slot, st, m, from_slot)
+
+    # ---- select ----
+    out_st, out_resp = st, no_resp
+    out_st = _sel(is_vote_req, st_vote, out_st)
+    out_resp = _sel(is_vote_req, vote_resp, out_resp)
+
+    app_case = (m.type == T_APP) & leader_traffic_ok
+    out_st = _sel(app_case, st_app, out_st)
+    out_resp = _sel(app_case, app_resp, out_resp)
+
+    hb_case = (m.type == T_HB) & leader_traffic_ok
+    out_st = _sel(hb_case, st_hb, out_st)
+    out_resp = _sel(hb_case, hb_resp, out_resp)
+
+    snap_case = (m.type == T_SNAP) & leader_traffic_ok
+    out_st = _sel(snap_case, st_snap, out_st)
+    out_resp = _sel(snap_case, snap_resp, out_resp)
+
+    out_st = _sel((m.type == T_APP_RESP) & is_leader, st_ar, out_st)
+    out_st = _sel((m.type == T_HB_RESP) & is_leader, st_hr, out_st)
+    out_st = _sel(is_cand & (m.type == my_resp_type), st_vr, out_st)
+    return out_st, out_resp
+
+
+def _handle_append(cfg: BatchedConfig, st: BatchedState, m: MsgSlots):
+    """Follower append handling (ref: raft.go:1475-1511 +
+    log.go maybeAppend/findConflict)."""
+    e = cfg.max_ents_per_msg
+    no_resp = empty_msgs((), e)
+    prev = m.index
+
+    # Fast path: stale append below commit acks the commit index.
+    below_commit = prev < st.commit
+    resp_below = no_resp._replace(
+        valid=True, type=jnp.asarray(T_APP_RESP, I32), index=st.commit,
+        term=st.term,
+    )
+
+    ta = lambda i: term_at(st.log_term, st.snap_index, st.snap_term, st.last, i)
+    match_ok = ta(prev) == m.log_term
+
+    j = jnp.arange(e, dtype=I32)
+    idx = prev + 1 + j
+    have = j < m.n_ents
+    existing = jax.vmap(ta)(idx)
+    conflict = have & ((idx > st.last) | (existing != m.ent_terms))
+    any_conflict = jnp.any(conflict)
+    ci = jnp.argmax(conflict)  # first conflicting offset
+
+    write_mask = have & (j >= ci) & any_conflict
+    w = st.log_term.shape[-1]
+    pos = idx % w
+    log = st.log_term.at[pos].set(
+        jnp.where(write_mask, m.ent_terms, st.log_term[pos])
+    )
+    last = jnp.where(any_conflict, prev + m.n_ents, st.last)
+    lastnewi = prev + m.n_ents
+    commit = jnp.maximum(st.commit, jnp.minimum(m.commit, lastnewi))
+    st_ok = st._replace(log_term=log, last=last, commit=commit)
+    resp_ok = no_resp._replace(
+        valid=True, type=jnp.asarray(T_APP_RESP, I32), index=lastnewi,
+        term=st.term,
+    )
+
+    # Reject with a term-skipping hint (ref: raft.go:1487-1509).
+    hint0 = jnp.minimum(prev, st.last)
+    hint = find_conflict_by_term(
+        st.log_term, st.snap_index, st.snap_term, st.last, hint0, m.log_term
+    )
+    resp_rej = no_resp._replace(
+        valid=True,
+        type=jnp.asarray(T_APP_RESP, I32),
+        index=prev,
+        reject=True,
+        reject_hint=hint,
+        log_term=ta(hint),
+        term=st.term,
+    )
+
+    st_out = _sel(below_commit, st, _sel(match_ok, st_ok, st))
+    resp = _sel(below_commit, resp_below, _sel(match_ok, resp_ok, resp_rej))
+    return st_out, resp
+
+
+def _handle_snapshot(cfg: BatchedConfig, st: BatchedState, m: MsgSlots):
+    """Follower snapshot install (ref: raft.go:1518-1614 restore). The
+    conf state rides host-side; on device membership masks are already
+    current. m.index/m.log_term carry the snapshot (index, term)."""
+    no_resp = empty_msgs((), cfg.max_ents_per_msg)
+    ignore = m.index <= st.commit
+    ta = lambda i: term_at(st.log_term, st.snap_index, st.snap_term, st.last, i)
+    fast_forward = ta(m.index) == m.log_term
+
+    st_ff = st._replace(commit=jnp.maximum(st.commit, m.index))
+    st_restore = st._replace(
+        log_term=jnp.zeros_like(st.log_term),
+        snap_index=m.index,
+        snap_term=m.log_term,
+        last=m.index,
+        commit=m.index,
+    )
+    restored = ~ignore & ~fast_forward
+    st_out = _sel(ignore, st, _sel(fast_forward, st_ff, st_restore))
+    resp = no_resp._replace(
+        valid=True,
+        type=jnp.asarray(T_APP_RESP, I32),
+        index=jnp.where(restored, m.index, st_out.commit),
+        term=st.term,
+    )
+    return st_out, resp
+
+
+def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
+    """Leader MsgAppResp handling (ref: raft.go:1106-1283)."""
+    r = st.match.shape[-1]
+    peers = jnp.arange(r, dtype=I32)
+    at_s = peers == s
+    prog_ok = st.voter[s]  # progress exists (voters only in v1)
+
+    st = st._replace(recent_active=jnp.where(at_s, True, st.recent_active))
+
+    # --- rejected: move next back using the hint (ref: raft.go:1130-1236) ---
+    hint = jnp.where(
+        m.log_term > 0,
+        find_conflict_by_term(
+            st.log_term, st.snap_index, st.snap_term, st.last, m.reject_hint,
+            m.log_term,
+        ),
+        m.reject_hint,
+    )
+    in_repl = st.pr_state[s] == REPLICATE
+    stale_rej = jnp.where(
+        in_repl, m.index <= st.match[s], st.next[s] - 1 != m.index
+    )
+    dec_next = jnp.where(
+        in_repl,
+        st.match[s] + 1,
+        jnp.maximum(jnp.minimum(m.index, hint + 1), 1),
+    )
+    # On a genuine rejection a replicating peer drops to probing
+    # (becomeProbe: next=match+1, reset probe bookkeeping).
+    st_rej = st._replace(
+        next=jnp.where(at_s, dec_next, st.next),
+        probe_sent=jnp.where(at_s, False, st.probe_sent),
+        pr_state=jnp.where(at_s & in_repl, PROBE, st.pr_state),
+        pending_snapshot=jnp.where(at_s & in_repl, 0, st.pending_snapshot),
+        inflight=jnp.where(at_s & in_repl, 0, st.inflight),
+        send_append=st.send_append | (at_s & ~stale_rej),
+    )
+    st_rej = _sel(stale_rej, st, st_rej)
+
+    # --- accepted: MaybeUpdate + state transitions + commit ---
+    old_paused = _paused(cfg, st)[s]
+    updated = st.match[s] < m.index
+    match = jnp.where(at_s, jnp.maximum(st.match, m.index), st.match)
+    nxt = jnp.where(at_s, jnp.maximum(st.next, m.index + 1), st.next)
+    st_acc = st._replace(
+        match=match,
+        next=nxt,
+        probe_sent=jnp.where(at_s & updated, False, st.probe_sent),
+    )
+
+    was_probe = st.pr_state[s] == PROBE
+    was_snap = (st.pr_state[s] == SNAPSHOT) & (
+        match[s] >= st.pending_snapshot[s]
+    )
+    to_replicate = updated & (was_probe | was_snap)
+    st_acc = st_acc._replace(
+        pr_state=jnp.where(at_s & to_replicate, REPLICATE, st_acc.pr_state),
+        pending_snapshot=jnp.where(
+            at_s & to_replicate, 0, st_acc.pending_snapshot
+        ),
+        inflight=jnp.where(
+            at_s & updated, 0, st_acc.inflight
+        ),  # count+watermark degeneration of FreeLE
+        next=jnp.where(
+            at_s & to_replicate, match[s] + 1, nxt
+        ),
+    )
+    committed_before = st_acc.commit
+    st_acc = _maybe_commit(st_acc)
+    advanced = st_acc.commit > committed_before
+    # bcastAppend on commit advance; resend to a previously-paused peer;
+    # keep draining while entries remain (ref: raft.go:1259-1276).
+    more = st_acc.last >= st_acc.next[s]
+    st_acc = st_acc._replace(
+        send_append=jnp.where(
+            advanced,
+            st_acc.send_append | st_acc.voter,
+            st_acc.send_append | (at_s & (old_paused | more)),
+        )
+    )
+    st_acc = _sel(updated, st_acc, st)
+
+    out = _sel(m.reject, st_rej, st_acc)
+    return _sel(prog_ok, out, st)
+
+
+def _leader_hb_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
+    """ref: raft.go:1284-1309 (ReadIndex ack bookkeeping is host-side)."""
+    r = st.match.shape[-1]
+    peers = jnp.arange(r, dtype=I32)
+    at_s = peers == s
+    full = st.inflight >= cfg.max_inflight
+    st2 = st._replace(
+        recent_active=jnp.where(at_s, True, st.recent_active),
+        probe_sent=jnp.where(at_s, False, st.probe_sent),
+        inflight=jnp.where(
+            at_s & (st.pr_state == REPLICATE) & full,
+            jnp.maximum(st.inflight - 1, 0),
+            st.inflight,
+        ),
+        send_append=st.send_append | (at_s & (st.match < st.last)),
+    )
+    return _sel(st.voter[s], st2, st)
+
+
+def _candidate_vote_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                         m: MsgSlots, s):
+    """ref: raft.go:1399-1414."""
+    st2, res = _record_vote_and_tally(st, s, ~m.reject)
+    won, lost = res == VOTE_WON, res == VOTE_LOST
+    if cfg.pre_vote:
+        st_won_pre = _campaign(cfg, st2, iid, slot, False)
+    else:
+        st_won_pre = st2
+    st_won_real = _become_leader(cfg, st2, iid, slot)
+    peers_mask = st_won_real.voter & (
+        jnp.arange(st.match.shape[-1], dtype=I32) != slot
+    )
+    st_won_real = st_won_real._replace(
+        send_append=st_won_real.send_append | peers_mask
+    )
+    is_pre = st.role == PRECANDIDATE
+    st_won = _sel(is_pre, st_won_pre, st_won_real)
+    st_lost = _become_follower(cfg, st2, iid, slot, st2.term, 0)
+    return _sel(won, st_won, _sel(lost, st_lost, st2))
+
+
+# -----------------------------------------------------------------------------
+# Phases: deliver (scan) / tick / propose / emit
+# -----------------------------------------------------------------------------
+
+
+def _deliver_all(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                 inbox: MsgSlots):
+    """Scan this instance's R*K inbox slots in fixed (sender, kind)
+    order; collect responses for request kinds 0..2."""
+    r = cfg.num_replicas
+    m_flat = jax.tree.map(
+        lambda x: x.reshape((r * NUM_KINDS,) + x.shape[2:]), inbox
+    )
+    senders = jnp.repeat(jnp.arange(r, dtype=I32), NUM_KINDS)
+
+    def body(carry, xs):
+        msg, sender = xs
+        st2, resp = _deliver_one(cfg, iid, slot, carry, msg, sender)
+        return st2, resp
+
+    st_out, resps = jax.lax.scan(body, st, (m_flat, senders))
+    # [R*K] responses → [R, K]; requests live in kinds 0..2, their
+    # responses route back to the sender in kinds 3..5.
+    resps = jax.tree.map(
+        lambda x: x.reshape((r, NUM_KINDS) + x.shape[1:]), resps
+    )
+    req_resps = jax.tree.map(lambda x: x[:, :3], resps)  # [R, 3]
+    return st_out, req_resps
+
+
+def _tick(cfg: BatchedConfig, iid, slot, st: BatchedState, do_tick,
+          do_campaign):
+    """ref: raft.go:645-684 tickElection/tickHeartbeat."""
+    r = cfg.num_replicas
+    peers = jnp.arange(r, dtype=I32)
+    is_leader = st.role == LEADER
+
+    ee = st.election_elapsed + jnp.where(do_tick, 1, 0)
+    he = st.heartbeat_elapsed + jnp.where(do_tick & is_leader, 1, 0)
+
+    # Leader heartbeat firing.
+    hb_fire = is_leader & (he >= cfg.heartbeat_timeout)
+    cq_fire = is_leader & (ee >= cfg.election_timeout)
+    st1 = st._replace(
+        election_elapsed=jnp.where(cq_fire, 0, ee),
+        heartbeat_elapsed=jnp.where(hb_fire, 0, he),
+        send_heartbeat=st.send_heartbeat
+        | (hb_fire & st.voter & (peers != slot)),
+    )
+    if cfg.check_quorum:
+        # Leader self-check every election timeout: step down when a
+        # quorum hasn't been heard from, then re-arm the activity bits
+        # (ref: raft.go:997-1018 MsgCheckQuorum).
+        active = jnp.where(peers == slot, True, st1.recent_active)
+        votes = jnp.where(active, 1, 0)
+        alive = vote_result(votes, st1.voter) == VOTE_WON
+        st_down = _become_follower(cfg, st1, iid, slot, st1.term, 0)
+        st1 = _sel(cq_fire & ~alive, st_down, st1)
+        st1 = st1._replace(
+            recent_active=jnp.where(
+                cq_fire, peers == slot, st1.recent_active
+            )
+        )
+
+    # Follower/candidate election firing.
+    promotable = st.voter[slot]
+    fire = (
+        (~is_leader & promotable & (ee >= st.randomized_timeout)) | do_campaign
+    ) & (st.role != LEADER)
+    st1 = st1._replace(
+        election_elapsed=jnp.where(fire & ~is_leader, 0, st1.election_elapsed)
+    )
+    st_camp = _campaign(cfg, st1, iid, slot, cfg.pre_vote)
+    return _sel(fire, st_camp, st1)
+
+
+def _propose(cfg: BatchedConfig, slot, st: BatchedState, n_new):
+    """Append n_new proposals on leader instances; payload bytes stay in
+    the host arena keyed by (group, index) (ref: v3_server.go Propose →
+    appendEntry → bcastAppend)."""
+    r = cfg.num_replicas
+    peers = jnp.arange(r, dtype=I32)
+    is_leader = st.role == LEADER
+    headroom = jnp.maximum(
+        cfg.window - (st.last - st.snap_index) - cfg.max_props_per_round, 0
+    )
+    n = jnp.clip(jnp.where(is_leader, n_new, 0), 0, cfg.max_props_per_round)
+    n = jnp.minimum(n, headroom)
+    st2 = _append_own(cfg, st, slot, n)
+    st2 = st2._replace(
+        send_append=st2.send_append | ((n > 0) & st2.voter & (peers != slot))
+    )
+    return _sel(n > 0, st2, st)
+
+
+def _emit(cfg: BatchedConfig, slot, st: BatchedState):
+    """Materialize pending sends into an outbox [R, K] and clear flags;
+    auto-apply committed entries (device applies immediately; the host
+    drains (group, index) ranges for real payload apply)."""
+    e = cfg.max_ents_per_msg
+    r = cfg.num_replicas
+    peers = jnp.arange(r, dtype=I32)
+    out = empty_msgs((r, NUM_KINDS), e)
+
+    # Device-side apply + compaction first: committed == applied on
+    # device (payload apply is the host's job, driven from the commit
+    # watermark), and with auto_compact the snapshot floor chases the
+    # applied watermark so the ring never fills. Stale ring slots below
+    # the floor need no clearing — term_at bounds exclude them.
+    st = st._replace(applied=jnp.maximum(st.applied, st.commit))
+    if cfg.auto_compact:
+        ta0 = lambda i: term_at(
+            st.log_term, st.snap_index, st.snap_term, st.last, i
+        )
+        keep = cfg.window // 2
+        new_snap = jnp.maximum(
+            st.snap_index, jnp.minimum(st.applied, st.last - keep)
+        )
+        st = st._replace(snap_term=ta0(new_snap), snap_index=new_snap)
+
+    ta = lambda i: term_at(st.log_term, st.snap_index, st.snap_term, st.last, i)
+
+    is_peer = st.voter & (peers != slot)
+    is_leader = st.role == LEADER
+
+    # --- vote requests (ref: raft.go:822-834) ---
+    vr = st.send_vote_req & is_peer
+    vtype = jnp.where(st.vote_req_is_pre, T_PREVOTE, T_VOTE)
+    vterm = jnp.where(st.vote_req_is_pre, st.term + 1, st.term)
+    out = out._replace(
+        valid=out.valid.at[:, KIND_VOTE].set(vr),
+        type=out.type.at[:, KIND_VOTE].set(vtype),
+        term=out.term.at[:, KIND_VOTE].set(vterm),
+        index=out.index.at[:, KIND_VOTE].set(st.last),
+        log_term=out.log_term.at[:, KIND_VOTE].set(ta(st.last)),
+    )
+
+    # --- heartbeats (ref: raft.go:495-511) ---
+    hb = st.send_heartbeat & is_peer & is_leader
+    out = out._replace(
+        valid=out.valid.at[:, KIND_HB].set(hb),
+        type=out.type.at[:, KIND_HB].set(T_HB),
+        term=out.term.at[:, KIND_HB].set(st.term),
+        commit=out.commit.at[:, KIND_HB].set(
+            jnp.minimum(st.match, st.commit)
+        ),
+    )
+
+    # --- appends / snapshots (ref: raft.go:432-492 maybeSendAppend) ---
+    want = st.send_append & is_peer & is_leader & ~_paused(cfg, st)
+    prev = st.next - 1
+    snap_needed = prev < st.snap_index
+    n_send = jnp.clip(st.last - prev, 0, e)  # [R]
+    j = jnp.arange(e, dtype=I32)
+    ent_idx = prev[:, None] + 1 + j[None, :]  # [R, E]
+    ent_terms = jax.vmap(jax.vmap(ta))(ent_idx)
+    ent_mask = j[None, :] < n_send[:, None]
+    app = want & ~snap_needed
+    snp = want & snap_needed
+
+    out = out._replace(
+        valid=out.valid.at[:, KIND_APP].set(app | snp),
+        type=out.type.at[:, KIND_APP].set(jnp.where(snp, T_SNAP, T_APP)),
+        term=out.term.at[:, KIND_APP].set(st.term),
+        index=out.index.at[:, KIND_APP].set(
+            jnp.where(snp, st.snap_index, prev)
+        ),
+        log_term=out.log_term.at[:, KIND_APP].set(
+            jnp.where(snp, st.snap_term, jax.vmap(ta)(prev))
+        ),
+        commit=out.commit.at[:, KIND_APP].set(st.commit),
+        n_ents=out.n_ents.at[:, KIND_APP].set(jnp.where(app, n_send, 0)),
+        ent_terms=out.ent_terms.at[:, KIND_APP].set(
+            jnp.where(ent_mask & app[:, None], ent_terms, 0)
+        ),
+    )
+
+    # Progress effects of the sends.
+    sent_ents = app & (n_send > 0)
+    st = st._replace(
+        probe_sent=st.probe_sent | (sent_ents & (st.pr_state == PROBE)),
+        next=jnp.where(
+            sent_ents & (st.pr_state == REPLICATE), st.next + n_send, st.next
+        ),
+        inflight=jnp.where(
+            sent_ents & (st.pr_state == REPLICATE),
+            st.inflight + 1,
+            st.inflight,
+        ),
+        pr_state=jnp.where(snp, SNAPSHOT, st.pr_state),
+        pending_snapshot=jnp.where(snp, st.snap_index, st.pending_snapshot),
+        send_append=jnp.zeros_like(st.send_append),
+        send_heartbeat=jnp.zeros_like(st.send_heartbeat),
+        send_vote_req=jnp.zeros_like(st.send_vote_req),
+    )
+    return st, out
+
+
+# -----------------------------------------------------------------------------
+# Round assembly + router
+# -----------------------------------------------------------------------------
+
+
+def route(cfg: BatchedConfig, outbox: MsgSlots) -> MsgSlots:
+    """All-device network: outbox[i, target_slot, k] → inbox[t, sender_slot, k]
+    where i=(g, s) and t=(g, r). With the dense instance layout this is
+    one transpose per field — the ICI-friendly formulation of rafthttp's
+    peer streams (ref: SURVEY.md §5 "Distributed communication backend")."""
+    g, r = cfg.num_groups, cfg.num_replicas
+
+    def tr(x):
+        # [G*R_sender, R_target, K, ...] → [G, R_target, R_sender, K, ...]
+        y = x.reshape((g, r) + x.shape[1:])
+        y = jnp.swapaxes(y, 1, 2)
+        return y.reshape((g * r,) + x.shape[1:])
+
+    inbox = jax.tree.map(tr, outbox)
+    # Requests (kinds 0..2) arrive as-is; responses were produced into
+    # kinds 0..2 of the responder's outbox rows and must land in kinds
+    # 3..5 of the requester's inbox. The emit/deliver split already wrote
+    # them to separate kind lanes, so nothing further to do here.
+    return inbox
+
+
+def make_step_round(cfg: BatchedConfig):
+    """Build the jitted round function:
+
+        state, outbox = step_round(state, inbox, tick_mask, campaign_mask,
+                                   propose_n)
+
+    All arrays stay on device; chain with route() for a closed-loop
+    multi-raft simulation."""
+    iids = jnp.arange(cfg.num_instances, dtype=I32)
+    slots = iids % cfg.num_replicas
+
+    def step_round(st: BatchedState, inbox: MsgSlots, tick_mask, campaign_mask,
+                   propose_n, isolate):
+        def per_instance(iid, slot, sti, inbox_i, do_tick, do_camp, n_new,
+                         iso):
+            # Partitioned instances neither receive nor send this round
+            # (fault injection; ref: tests/framework bridge & pkg/proxy).
+            inbox_i = inbox_i._replace(valid=inbox_i.valid & ~iso)
+            sti, req_resps = _deliver_all(cfg, iid, slot, sti, inbox_i)
+            sti = _tick(cfg, iid, slot, sti, do_tick, do_camp)
+            sti = _propose(cfg, slot, sti, n_new)
+            sti, out = _emit(cfg, slot, sti)
+            # Responses to requests from sender s (kinds 0..2) land in
+            # out[s, 3+k]; they route back by the same transpose.
+            out = jax.tree.map(
+                lambda o, rr: o.at[:, 3:].set(rr), out, req_resps
+            )
+            out = out._replace(valid=out.valid & ~iso)
+            return sti, out
+
+        return jax.vmap(per_instance)(
+            iids, slots, st, inbox, tick_mask, campaign_mask, propose_n,
+            isolate,
+        )
+
+    return jax.jit(step_round)

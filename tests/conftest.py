@@ -2,19 +2,29 @@
 
 Sharding/distributed tests run on a virtual 8-device CPU mesh: real
 multi-chip TPU hardware is not available in CI, and XLA's
-host-platform-device-count flag gives us N independent devices with the
-same SPMD semantics. Must be set before jax is imported anywhere.
+host-platform-device-count flag gives N independent devices with the
+same SPMD semantics.
+
+The ambient environment routes jax to a single-client TPU tunnel (the
+axon sitecustomize imports jax at interpreter start, freezing
+JAX_PLATFORMS=axon into the config before this file runs). Tests must
+never grab that tunnel — concurrent clients wedge it — so we force the
+platform back to CPU via jax.config before any backend initializes.
+bench.py / the driver keep the TPU path.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
