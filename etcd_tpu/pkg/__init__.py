@@ -1,0 +1,9 @@
+"""Support libraries (analog of the reference's ``pkg/`` module).
+
+Each submodule is a fresh, idiomatic-Python redesign of one reference
+package (cited per-module); together they provide the host-side plumbing
+the replicated server is built from: the id→event wait registry, FIFO
+apply scheduler, request-id generator, interval tree (auth ranges and
+watcher groups), request tracing, heartbeat-contention detection,
+benchmark statistics, and broadcast notification.
+"""

@@ -1,0 +1,91 @@
+import random
+
+from etcd_tpu.pkg.adt import Interval, IntervalTree, point_interval
+
+
+def test_basic_insert_find():
+    t = IntervalTree()
+    t.insert(Interval(b"a", b"c"), 1)
+    t.insert(Interval(b"c", b"f"), 2)
+    assert len(t) == 2
+    assert t.find(Interval(b"a", b"c")) == 1
+    assert t.find(Interval(b"a", b"d")) is None
+    # equal-interval insert replaces
+    t.insert(Interval(b"a", b"c"), 10)
+    assert len(t) == 2
+    assert t.find(Interval(b"a", b"c")) == 10
+
+
+def test_stab_half_open():
+    t = IntervalTree()
+    t.insert(Interval(b"a", b"c"), "ac")
+    t.insert(Interval(b"c", b"f"), "cf")
+    assert t.stab(b"b") == ["ac"]
+    assert t.stab(b"c") == ["cf"]  # end is exclusive
+    assert t.stab(b"f") == []
+
+
+def test_intersects_and_visit():
+    t = IntervalTree()
+    t.insert(Interval(1, 5), "a")
+    t.insert(Interval(10, 20), "b")
+    t.insert(Interval(3, 12), "c")
+    assert t.intersects(Interval(4, 6))
+    assert not t.intersects(Interval(20, 30))
+    got = [v for _, v in t.visit_items(Interval(4, 11))]
+    assert got == ["a", "c", "b"]  # sorted by begin
+
+
+def test_visit_early_stop():
+    t = IntervalTree()
+    for i in range(10):
+        t.insert(Interval(i, i + 1), i)
+    seen = []
+
+    def fn(ivl, v):
+        seen.append(v)
+        return len(seen) < 3
+
+    t.visit(Interval(0, 10), fn)
+    assert seen == [0, 1, 2]
+
+
+def test_delete():
+    t = IntervalTree()
+    t.insert(Interval(1, 5), "a")
+    t.insert(Interval(2, 6), "b")
+    assert t.delete(Interval(1, 5))
+    assert not t.delete(Interval(1, 5))
+    assert len(t) == 1
+    assert t.stab(3) == ["b"]
+
+
+def test_randomized_against_bruteforce():
+    rng = random.Random(7)
+    t = IntervalTree()
+    model = {}
+    for _ in range(500):
+        op = rng.random()
+        b = rng.randrange(0, 100)
+        e = b + rng.randrange(1, 20)
+        if op < 0.55:
+            t.insert(Interval(b, e), (b, e))
+            model[(b, e)] = (b, e)
+        elif op < 0.75 and model:
+            k = rng.choice(list(model))
+            t.delete(Interval(*k))
+            del model[k]
+        else:
+            p = rng.randrange(0, 120)
+            got = sorted(t.stab(p))
+            want = sorted(v for (mb, me), v in model.items() if mb <= p < me)
+            assert got == want
+        assert len(t) == len(model)
+    # full-range visit returns everything sorted
+    allv = [v for _, v in t.visit_items(Interval(-1, 1000))]
+    assert allv == sorted(model.values())
+
+
+def test_point_interval_bytes():
+    ivl = point_interval(b"k")
+    assert ivl.begin == b"k" and ivl.end == b"k\x00"

@@ -1,0 +1,77 @@
+import time
+
+from etcd_tpu.pkg.contention import TimeoutDetector
+from etcd_tpu.pkg.idutil import Generator
+from etcd_tpu.pkg.notify import Notifier
+from etcd_tpu.pkg.report import Report
+from etcd_tpu.pkg.schedule import FIFOScheduler
+
+
+def test_idutil_unique_monotonic():
+    g = Generator(member_id=0x1234)
+    ids = [g.next() for _ in range(1000)]
+    assert len(set(ids)) == 1000
+    assert ids == sorted(ids)
+    # member prefix occupies the top 16 bits
+    assert all((i >> 48) == 0x1234 for i in ids)
+
+
+def test_idutil_member_disjoint():
+    a = Generator(1, now_ms=1000)
+    b = Generator(2, now_ms=1000)
+    assert not {a.next() for _ in range(100)} & {b.next() for _ in range(100)}
+
+
+def test_fifo_scheduler_order():
+    s = FIFOScheduler()
+    out = []
+    for i in range(50):
+        s.schedule(lambda i=i: out.append(i))
+    s.wait_finish(50)
+    assert out == list(range(50))
+    assert s.pending() == 0
+    s.stop()
+
+
+def test_fifo_scheduler_job_exception_does_not_kill_worker():
+    s = FIFOScheduler()
+    out = []
+    s.schedule(lambda: 1 / 0)
+    s.schedule(lambda: out.append("ok"))
+    s.wait_finish(2)
+    assert out == ["ok"]
+    s.stop()
+
+
+def test_contention_detector():
+    d = TimeoutDetector(max_duration=0.05)
+    ok, _ = d.observe(1)
+    assert ok
+    ok, _ = d.observe(1)
+    assert ok  # immediate second observation is fine
+    time.sleep(0.08)
+    ok, exceeded = d.observe(1)
+    assert not ok and exceeded > 0
+
+
+def test_notifier_generations():
+    n = Notifier()
+    ev1 = n.receive()
+    n.notify()
+    assert ev1.is_set()
+    ev2 = n.receive()
+    assert not ev2.is_set()
+    n.notify()
+    assert ev2.is_set()
+
+
+def test_report_percentiles():
+    r = Report()
+    for d in [0.001, 0.002, 0.003, 0.004, 0.100]:
+        r.results(d)
+    r.results(0.5, err=ValueError("x"))
+    s = r.stats()
+    assert s.count == 5 and s.errors == 1
+    assert s.percentiles_ms["50"] <= s.percentiles_ms["99"]
+    assert s.max_ms >= 100.0
+    assert "p50" in r.render() or "p50:" in r.render()

@@ -1,0 +1,56 @@
+import threading
+
+import pytest
+
+from etcd_tpu.pkg.wait import Wait, WaitTime
+
+
+def test_register_trigger():
+    w = Wait()
+    waiter = w.register(1)
+    assert w.is_registered(1)
+    assert w.trigger(1, "done")
+    assert waiter.wait(1.0) == "done"
+    assert not w.is_registered(1)
+    assert not w.trigger(1, "again")
+
+
+def test_dup_register_raises():
+    w = Wait()
+    w.register(7)
+    with pytest.raises(RuntimeError):
+        w.register(7)
+
+
+def test_cross_thread():
+    w = Wait()
+    waiter = w.register(42)
+    t = threading.Thread(target=lambda: w.trigger(42, 99))
+    t.start()
+    assert waiter.wait(2.0) == 99
+    t.join()
+
+
+def test_wait_timeout():
+    w = Wait()
+    waiter = w.register(5)
+    with pytest.raises(TimeoutError):
+        waiter.wait(0.01)
+
+
+def test_wait_time_past_deadline_immediate():
+    wt = WaitTime()
+    wt.trigger(10)
+    assert wt.wait(5).is_set()
+    assert wt.wait(10).is_set()
+    assert not wt.wait(11).is_set()
+
+
+def test_wait_time_future():
+    wt = WaitTime()
+    ev = wt.wait(3)
+    assert not ev.is_set()
+    wt.trigger(2)
+    assert not ev.is_set()
+    wt.trigger(3)
+    assert ev.is_set()
