@@ -1,0 +1,48 @@
+"""Native (C++) runtime components, loaded over ctypes.
+
+The hot host-side I/O paths — WAL segment framing/CRC/fsync — are C++
+(``src/walog.cc``), mirroring how the reference keeps its durable-log
+machinery out of the request path's interpreted layers. The shared
+library is built on first import with g++ and cached next to the
+sources; rebuilds trigger automatically when a source file is newer
+than the cached .so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_LIB = os.path.join(_DIR, "lib")
+
+_lock = threading.Lock()
+_cache: dict[str, ctypes.CDLL] = {}
+
+
+def _build(name: str) -> str:
+    src = os.path.join(_SRC, f"{name}.cc")
+    out = os.path.join(_LIB, f"lib{name}.so")
+    os.makedirs(_LIB, exist_ok=True)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    tmp = out + f".tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-g", "-std=c++17", "-fPIC", "-shared",
+        "-Wall", "-Wextra", "-o", tmp, src,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, out)
+    return out
+
+
+def load(name: str) -> ctypes.CDLL:
+    with _lock:
+        lib = _cache.get(name)
+        if lib is None:
+            lib = ctypes.CDLL(_build(name))
+            _cache[name] = lib
+        return lib

@@ -1,0 +1,2 @@
+"""Durable storage: WAL, backend, snapshots, MVCC (analog of the
+reference's ``server/storage``)."""

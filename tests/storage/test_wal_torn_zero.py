@@ -1,0 +1,49 @@
+"""Torn-write classification: a complete-looking record whose payload
+sectors are zeros (preallocated space never flushed) is repairable; a
+record with nonzero garbage failing its crc is corruption."""
+
+import os
+import struct
+
+import pytest
+
+from etcd_tpu.raft.types import Entry, HardState
+from etcd_tpu.storage.wal import WAL
+
+
+def _tail_segment(d):
+    return os.path.join(
+        d, sorted(f for f in os.listdir(d) if f.endswith(".wal"))[-1]
+    )
+
+
+def test_zero_filled_record_is_torn(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WAL.create(d)
+    w.save(HardState(term=1, vote=1, commit=0),
+           [Entry(term=1, index=1, data=b"a")])
+    w.close()
+    # crash scenario: header for a 1KiB record written, payload sectors
+    # still zero from preallocation
+    with open(_tail_segment(d), "ab") as f:
+        f.write(struct.pack("<IBxxxI", 1024, 2, 0xDEAD))
+        f.write(b"\x00" * 1024)
+    w2 = WAL.open(d)  # repairs: truncates the torn record
+    _, _, es = w2.read_all()
+    assert [e.index for e in es] == [1]
+    w2.close()
+
+
+def test_nonzero_garbage_is_corruption(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WAL.create(d)
+    w.save(HardState(term=1, vote=1, commit=0),
+           [Entry(term=1, index=1, data=b"a")])
+    w.close()
+    # a *complete* record of nonzero bytes failing its crc — this data
+    # was supposedly durable, so refuse to silently drop it
+    with open(_tail_segment(d), "ab") as f:
+        f.write(struct.pack("<IBxxxI", 64, 2, 0xDEAD))
+        f.write(bytes(range(1, 65)) + b"\x00\x00\x00\x00")  # incl. padding
+    with pytest.raises(Exception, match="corrupt"):
+        WAL.open(d)
