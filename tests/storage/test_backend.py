@@ -1,0 +1,158 @@
+import threading
+import time
+
+from etcd_tpu.storage import backend as bk
+
+
+def make(tmp_path, **kw):
+    return bk.Backend(str(tmp_path / "db.sqlite"), **kw)
+
+
+def test_put_get_visible_before_commit(tmp_path):
+    b = make(tmp_path, batch_interval=10.0)  # no auto commit during test
+    with b.batch_tx.lock:
+        b.batch_tx.put(bk.TEST, b"k1", b"v1")
+    rt = b.read_tx()
+    assert rt.get(bk.TEST, b"k1") == b"v1"  # visible pre-commit
+    assert b.commits == 1  # only the schema commit
+    b.force_commit()
+    assert b.read_tx().get(bk.TEST, b"k1") == b"v1"
+    b.close()
+
+
+def test_range_and_delete_range(tmp_path):
+    b = make(tmp_path, batch_interval=10.0)
+    with b.batch_tx.lock:
+        for i in range(10):
+            b.batch_tx.put(bk.TEST, f"k{i}".encode(), f"v{i}".encode())
+    rt = b.read_tx()
+    rows = rt.range(bk.TEST, b"k2", b"k5")
+    assert [k for k, _ in rows] == [b"k2", b"k3", b"k4"]
+    assert rt.range(bk.TEST, b"k2", b"k5", limit=2)[-1][0] == b"k3"
+    with b.batch_tx.lock:
+        n = b.batch_tx.delete_range(bk.TEST, b"k2", b"k5")
+    assert n == 3
+    assert [k for k, _ in b.read_tx().range(bk.TEST, b"k0", b"k9")] == [
+        b"k0", b"k1", b"k5", b"k6", b"k7", b"k8",
+    ]
+    b.close()
+
+
+def test_concurrent_read_tx_isolation(tmp_path):
+    b = make(tmp_path, batch_interval=10.0)
+    with b.batch_tx.lock:
+        b.batch_tx.put(bk.TEST, b"a", b"1")
+    crt = b.concurrent_read_tx()
+    assert crt.get(bk.TEST, b"a") == b"1"  # sees uncommitted buffer snapshot
+    with b.batch_tx.lock:
+        b.batch_tx.put(bk.TEST, b"a", b"2")
+        b.batch_tx.put(bk.TEST, b"b", b"9")
+    # snapshot view is frozen
+    assert crt.get(bk.TEST, b"a") == b"1"
+    assert crt.get(bk.TEST, b"b") is None
+    # live view moves
+    assert b.read_tx().get(bk.TEST, b"a") == b"2"
+    b.close()
+
+
+def test_auto_commit_interval(tmp_path):
+    b = make(tmp_path, batch_interval=0.02)
+    with b.batch_tx.lock:
+        b.batch_tx.put(bk.TEST, b"x", b"y")
+    deadline = time.monotonic() + 2.0
+    while b.batch_tx.pending() > 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert b.batch_tx.pending() == 0
+    assert b.commits >= 2
+    b.close()
+
+
+def test_batch_limit_triggers_commit(tmp_path):
+    b = make(tmp_path, batch_interval=10.0, batch_limit=50)
+    with b.batch_tx.lock:
+        for i in range(120):
+            b.batch_tx.put(bk.TEST, f"k{i:03}".encode(), b"v")
+    assert b.commits >= 3  # schema + two limit-triggered
+    b.close()
+
+
+def test_commit_hook_runs_in_commit(tmp_path):
+    b = make(tmp_path, batch_interval=10.0)
+    calls = []
+
+    def hook(tx):
+        calls.append(tx.pending())
+        tx.put(bk.META, b"cindex", b"42")
+
+    b.add_hook(hook)
+    with b.batch_tx.lock:
+        b.batch_tx.put(bk.TEST, b"k", b"v")
+    b.force_commit()
+    assert calls and calls[0] >= 1
+    assert b.read_tx().get(bk.META, b"cindex") == b"42"
+    b.close()
+
+
+def test_persistence_and_snapshot(tmp_path):
+    path = str(tmp_path / "db.sqlite")
+    b = bk.Backend(path, batch_interval=10.0)
+    with b.batch_tx.lock:
+        b.batch_tx.put(bk.TEST, b"p", b"q")
+    b.force_commit()
+    snap_path = str(tmp_path / "snap.sqlite")
+    b.snapshot_to(snap_path)
+    b.close()
+    # reopen original
+    b2 = bk.Backend(path, batch_interval=10.0)
+    assert b2.read_tx().get(bk.TEST, b"p") == b"q"
+    b2.close()
+    # snapshot is a valid backend
+    b3 = bk.Backend(snap_path, batch_interval=10.0)
+    assert b3.read_tx().get(bk.TEST, b"p") == b"q"
+    b3.close()
+
+
+def test_defrag_keeps_data(tmp_path):
+    b = make(tmp_path, batch_interval=10.0)
+    with b.batch_tx.lock:
+        for i in range(200):
+            b.batch_tx.put(bk.TEST, f"k{i:04}".encode(), b"x" * 500)
+    b.force_commit()
+    with b.batch_tx.lock:
+        b.batch_tx.delete_range(bk.TEST, b"k0000", b"k0150")
+    b.force_commit()
+    b.defrag()
+    assert b.read_tx().count(bk.TEST) == 50
+    assert b.size_in_use() > 0
+    b.close()
+
+
+def test_writer_reader_concurrency(tmp_path):
+    b = make(tmp_path, batch_interval=0.005)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            with b.batch_tx.lock:
+                b.batch_tx.put(bk.TEST, f"w{i % 100:03}".encode(), str(i).encode())
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                crt = b.concurrent_read_tx()
+                crt.range(bk.TEST, b"", b"\xff")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    ts = [threading.Thread(target=writer), threading.Thread(target=reader)]
+    for t in ts:
+        t.start()
+    time.sleep(0.4)
+    stop.set()
+    for t in ts:
+        t.join()
+    assert not errors
+    b.close()

@@ -1,0 +1,216 @@
+import pytest
+
+from etcd_tpu.storage import backend as bk
+from etcd_tpu.storage.mvcc import (
+    CompactedError, FutureRevError, KeyIndex, KVStore, RangeOptions, Revision,
+)
+from etcd_tpu.storage.mvcc.key_index import RevisionNotFound
+
+
+def make_store(tmp_path, name="db"):
+    b = bk.Backend(str(tmp_path / f"{name}.sqlite"), batch_interval=10.0)
+    return b, KVStore(b)
+
+
+# -- keyIndex: the reference's behaviour table (key_index.go doc) -------------
+
+def ki_fixture():
+    ki = KeyIndex(key=b"foo")
+    ki.put(1, 0)
+    ki.put(2, 0)
+    ki.tombstone(3, 0)
+    ki.put(4, 0)
+    ki.tombstone(5, 0)
+    return ki
+
+
+def revs(ki):
+    return [[r.main for r in g.revs] for g in ki.generations]
+
+
+def test_key_index_generations():
+    ki = ki_fixture()
+    assert revs(ki) == [[1, 2, 3], [4, 5], []]
+    assert ki.modified == Revision(5, 0)
+
+
+def test_key_index_get():
+    ki = ki_fixture()
+    assert ki.get(1)[0] == Revision(1, 0)
+    assert ki.get(2)[0] == Revision(2, 0)
+    with pytest.raises(RevisionNotFound):
+        ki.get(3)  # tombstoned at 3
+    mod, created, ver = ki.get(4)
+    assert mod == Revision(4, 0) and created == Revision(4, 0) and ver == 1
+    with pytest.raises(RevisionNotFound):
+        ki.get(5)
+
+
+def test_key_index_compact_table():
+    ki = ki_fixture()
+    av = {}
+    ki.compact(2, av)
+    assert revs(ki) == [[2, 3], [4, 5], []]
+    assert av == {Revision(2, 0): True}
+
+    av = {}
+    ki.compact(4, av)
+    assert revs(ki) == [[4, 5], []]
+    assert av == {Revision(4, 0): True}
+
+    av = {}
+    ki.compact(5, av)
+    assert revs(ki) == [[]]
+    assert av == {}
+    assert ki.is_empty()  # caller removes the key
+
+
+def test_key_index_compact_6_removes():
+    ki = ki_fixture()
+    ki.compact(6, {})
+    assert ki.is_empty()
+
+
+def test_key_index_since():
+    ki = ki_fixture()
+    assert [r.main for r in ki.since(3)] == [3, 4, 5]
+    assert [r.main for r in ki.since(6)] == []
+    assert [r.main for r in ki.since(0)] == [1, 2, 3, 4, 5]
+
+
+# -- kvstore ------------------------------------------------------------------
+
+def test_put_range_versions(tmp_path):
+    b, s = make_store(tmp_path)
+    assert s.put(b"foo", b"bar") == 2  # first write → rev 2 (etcd semantics)
+    assert s.put(b"foo", b"bar2") == 3
+    assert s.put(b"baz", b"x") == 4
+    res = s.range(b"foo", None)
+    assert res.rev == 4 and res.count == 1
+    kv = res.kvs[0]
+    assert (kv.value, kv.create_revision, kv.mod_revision, kv.version) == (
+        b"bar2", 2, 3, 2)
+    # range at an old revision
+    res = s.range(b"foo", None, RangeOptions(rev=2))
+    assert res.kvs[0].value == b"bar" and res.kvs[0].version == 1
+    assert res.rev == 4  # header rev is always current
+    b.close()
+
+
+def test_range_prefix_limit_count(tmp_path):
+    b, s = make_store(tmp_path)
+    for i in range(5):
+        s.put(f"k{i}".encode(), f"v{i}".encode())
+    res = s.range(b"k", b"l")
+    assert [kv.key for kv in res.kvs] == [b"k0", b"k1", b"k2", b"k3", b"k4"]
+    res = s.range(b"k", b"l", RangeOptions(limit=2))
+    assert len(res.kvs) == 2 and res.count == 5
+    res = s.range(b"k", b"l", RangeOptions(count_only=True))
+    assert res.kvs == [] and res.count == 5
+    b.close()
+
+
+def test_delete_and_tombstone(tmp_path):
+    b, s = make_store(tmp_path)
+    s.put(b"a", b"1")
+    s.put(b"b", b"2")
+    n, rev = s.delete_range(b"a", None)
+    assert n == 1 and rev == 4
+    assert s.range(b"a", None).count == 0
+    # the old revision still readable
+    assert s.range(b"a", None, RangeOptions(rev=3)).kvs[0].value == b"1"
+    # delete of missing key deletes nothing, does not bump rev
+    n, rev = s.delete_range(b"zz", None)
+    assert n == 0 and s.rev() == 4
+    b.close()
+
+
+def test_txn_multiple_ops_one_rev(tmp_path):
+    b, s = make_store(tmp_path)
+    with s.write() as tx:
+        tx.put(b"x", b"1")
+        tx.put(b"y", b"2")
+        tx.delete_range(b"x", None)
+    assert s.rev() == 2
+    assert s.range(b"y", None).kvs[0].mod_revision == 2
+    assert s.range(b"x", None).count == 0
+    b.close()
+
+
+def test_compact(tmp_path):
+    b, s = make_store(tmp_path)
+    s.put(b"foo", b"v1")   # rev 2
+    s.put(b"foo", b"v2")   # rev 3
+    s.put(b"foo", b"v3")   # rev 4
+    s.put(b"bar", b"w1")   # rev 5
+    s.compact(3)
+    with pytest.raises(CompactedError):
+        s.range(b"foo", None, RangeOptions(rev=2))
+    # rev 3 survives (it's the visible version at the compact point)
+    assert s.range(b"foo", None, RangeOptions(rev=3)).kvs[0].value == b"v2"
+    assert s.range(b"foo", None).kvs[0].value == b"v3"
+    with pytest.raises(CompactedError):
+        s.compact(2)
+    with pytest.raises(FutureRevError):
+        s.compact(99)
+    b.close()
+
+
+def test_compact_removes_deleted_history(tmp_path):
+    b, s = make_store(tmp_path)
+    s.put(b"k", b"v")        # rev 2
+    s.delete_range(b"k", None)  # rev 3
+    s.put(b"k", b"v2")       # rev 4
+    s.compact(3)
+    # old generation gone; current generation intact
+    assert s.range(b"k", None).kvs[0].value == b"v2"
+    with pytest.raises(CompactedError):
+        s.range(b"k", None, RangeOptions(rev=2))
+    b.close()
+
+
+def test_future_rev_error(tmp_path):
+    b, s = make_store(tmp_path)
+    s.put(b"k", b"v")
+    with pytest.raises(FutureRevError):
+        s.range(b"k", None, RangeOptions(rev=99))
+    b.close()
+
+
+def test_restore_from_backend(tmp_path):
+    b, s = make_store(tmp_path)
+    s.put(b"foo", b"v1")
+    s.put(b"foo", b"v2")
+    s.put(b"bar", b"w")
+    s.delete_range(b"bar", None)
+    s.compact(3)
+    b.force_commit()
+    b.close()
+
+    b2 = bk.Backend(str(tmp_path / "db.sqlite"), batch_interval=10.0)
+    s2 = KVStore(b2)
+    assert s2.rev() == 5
+    assert s2.compact_rev == 3
+    assert s2.range(b"foo", None).kvs[0].value == b"v2"
+    assert s2.range(b"bar", None).count == 0
+    # version counters survive restore
+    assert s2.put(b"foo", b"v3") == 6
+    assert s2.range(b"foo", None).kvs[0].version == 3
+    b2.close()
+
+
+def test_hash_kv_stable_across_restore(tmp_path):
+    b, s = make_store(tmp_path)
+    s.put(b"a", b"1")
+    s.put(b"b", b"2")
+    h1, cur, crev = s.hash_kv()
+    b.force_commit()
+    b.close()
+    b2 = bk.Backend(str(tmp_path / "db.sqlite"), batch_interval=10.0)
+    s2 = KVStore(b2)
+    h2, cur2, _ = s2.hash_kv()
+    assert (h1, cur) == (h2, cur2)
+    s2.put(b"c", b"3")
+    h3, _, _ = s2.hash_kv()
+    assert h3 != h2
+    b2.close()
