@@ -1,0 +1,12 @@
+"""The raftexample-equivalent: a minimal replicated KV on the raft core
+(ref: contrib/raftexample — the canonical Ready loop outside etcdserver).
+
+This is the reference's "one model running end-to-end" slice: ticker →
+Node; proposal queue → MsgProp; Ready drain → WAL append/fsync →
+message router → apply to an in-memory KV; in-proc N-node network with
+fault injection for tests.
+"""
+
+from .transport import InProcNetwork  # noqa: F401
+from .raftnode import ExampleRaftNode  # noqa: F401
+from .kvstore import ReplicatedKV  # noqa: F401

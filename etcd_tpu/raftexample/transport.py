@@ -1,0 +1,123 @@
+"""In-process message router with fault injection.
+
+Plays the role of rafthttp for in-proc clusters: per-destination ordered
+delivery, drop-don't-block (ref: etcdserver/raft.go:108-111 comment),
+plus the fault hooks integration tests rely on (isolate/partition/drop —
+ref: tests/framework/integration bridge + raft/rafttest/network.go).
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..raft.types import Message
+
+MAX_PENDING = 4096
+
+
+class InProcNetwork:
+    def __init__(self, seed: int = 0) -> None:
+        self._lock = threading.Lock()
+        self._handlers: Dict[int, Callable[[Message], None]] = {}
+        self._queues: Dict[int, "queue.Queue[Message]"] = {}
+        self._pumps: Dict[int, threading.Thread] = {}
+        self._isolated: Set[int] = set()
+        self._dropped: Dict[Tuple[int, int], float] = {}
+        self._rand = random.Random(seed)
+        self._stopped = False
+
+    def register(self, node_id: int, handler: Callable[[Message], None]) -> None:
+        """Attach a node; messages to `node_id` are pumped on a dedicated
+        thread to preserve per-peer ordering without blocking senders."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._handlers[node_id] = handler
+            if node_id not in self._queues:
+                q: "queue.Queue[Message]" = queue.Queue(maxsize=MAX_PENDING)
+                self._queues[node_id] = q
+                t = threading.Thread(
+                    target=self._pump, args=(node_id, q), daemon=True
+                )
+                self._pumps[node_id] = t
+                t.start()
+
+    def unregister(self, node_id: int) -> None:
+        with self._lock:
+            self._handlers.pop(node_id, None)
+
+    def send(self, from_id: int, msgs: List[Message]) -> None:
+        for m in msgs:
+            self._send_one(from_id, m)
+
+    def _send_one(self, from_id: int, m: Message) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            if from_id in self._isolated or m.to in self._isolated:
+                return
+            if self._rand.random() < self._dropped.get((from_id, m.to), 0.0):
+                return
+            q = self._queues.get(m.to)
+        if q is None:
+            return
+        try:
+            q.put_nowait(m)  # drop, never block (rafthttp semantics)
+        except queue.Full:
+            pass
+
+    def _pump(self, node_id: int, q: "queue.Queue[Message]") -> None:
+        while True:
+            m = q.get()
+            if m is None:  # type: ignore[comparison-overlap]
+                return
+            with self._lock:
+                h = self._handlers.get(node_id)
+                stopped = self._stopped
+            if stopped:
+                return
+            if h is not None:
+                try:
+                    h(m)
+                except Exception:  # noqa: BLE001 — a dead node mustn't kill the pump
+                    pass
+
+    # -- fault injection (ref: rafttest/network.go:33-46) ----------------------
+
+    def isolate(self, node_id: int) -> None:
+        with self._lock:
+            self._isolated.add(node_id)
+
+    def heal(self, node_id: Optional[int] = None) -> None:
+        with self._lock:
+            if node_id is None:
+                self._isolated.clear()
+                self._dropped.clear()
+            else:
+                self._isolated.discard(node_id)
+
+    def drop(self, from_id: int, to_id: int, prob: float) -> None:
+        with self._lock:
+            self._dropped[(from_id, to_id)] = prob
+
+    def cut(self, a: int, b: int) -> None:
+        self.drop(a, b, 1.0)
+        self.drop(b, a, 1.0)
+
+    def mend(self, a: int, b: int) -> None:
+        with self._lock:
+            self._dropped.pop((a, b), None)
+            self._dropped.pop((b, a), None)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            queues = list(self._queues.values())
+        for q in queues:
+            try:
+                q.put_nowait(None)  # type: ignore[arg-type]
+            except queue.Full:
+                pass

@@ -1,0 +1,247 @@
+"""End-to-end tests of the raftexample slice: in-proc 3-node replicated
+KV over the raft core, WAL, snapshots, conf changes, fault recovery
+(ref: contrib/raftexample behavior; harness shape mirrors
+tests/framework/integration's in-proc cluster)."""
+
+import os
+import time
+
+import pytest
+
+from etcd_tpu.raft.types import ConfChange, ConfChangeType
+from etcd_tpu.raftexample import ExampleRaftNode, InProcNetwork, ReplicatedKV
+
+
+def wait_until(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_cluster(tmp_path, n=3, net=None, snap_count=10000):
+    net = net or InProcNetwork()
+    peers = list(range(1, n + 1))
+    kvs, nodes = {}, {}
+    for nid in peers:
+        kv = ReplicatedKV()
+        node = ExampleRaftNode(
+            node_id=nid,
+            peers=peers,
+            network=net,
+            data_dir=str(tmp_path),
+            apply_fn=kv.apply,
+            snapshot_fn=kv.snapshot,
+            restore_fn=kv.restore,
+            snap_count=snap_count,
+            tick_interval=0.01,
+        )
+        kv.attach(node)
+        kvs[nid], nodes[nid] = kv, node
+    return net, nodes, kvs
+
+
+def wait_leader(nodes, timeout=10.0):
+    live = {i: n for i, n in nodes.items() if not n._stopped.is_set()}
+    box = {}
+
+    def has_leader():
+        for n in live.values():
+            lead = n.leader()
+            if lead != 0 and lead in live and live[lead].is_leader():
+                box["lead"] = lead
+                return True
+        return False
+
+    wait_until(has_leader, timeout=timeout, msg="leader election")
+    return box["lead"]
+
+
+def stop_all(net, nodes):
+    for n in nodes.values():
+        n.stop()
+    net.stop()
+
+
+class TestThreeNodeCluster:
+    def test_propose_replicates_everywhere(self, tmp_path):
+        net, nodes, kvs = make_cluster(tmp_path)
+        try:
+            lead = wait_leader(nodes)
+            kvs[lead].propose("foo", "bar")
+            for nid in nodes:
+                wait_until(
+                    lambda nid=nid: kvs[nid].lookup("foo") == "bar",
+                    msg=f"replication to node {nid}",
+                )
+        finally:
+            stop_all(net, nodes)
+
+    def test_follower_proposal_forwarded(self, tmp_path):
+        net, nodes, kvs = make_cluster(tmp_path)
+        try:
+            lead = wait_leader(nodes)
+            follower = next(i for i in nodes if i != lead)
+            kvs[follower].propose("k", "v")
+            for nid in nodes:
+                wait_until(
+                    lambda nid=nid: kvs[nid].lookup("k") == "v",
+                    msg=f"replication to node {nid}",
+                )
+        finally:
+            stop_all(net, nodes)
+
+    def test_leader_failover(self, tmp_path):
+        net, nodes, kvs = make_cluster(tmp_path)
+        try:
+            lead = wait_leader(nodes)
+            kvs[lead].propose("before", "1")
+            survivors = [i for i in nodes if i != lead]
+            net.isolate(lead)
+            live = {i: nodes[i] for i in survivors}
+            new_lead = wait_leader(live, timeout=20.0)
+            assert new_lead != lead
+            kvs[new_lead].propose("after", "2")
+            for nid in survivors:
+                wait_until(
+                    lambda nid=nid: kvs[nid].lookup("after") == "2",
+                    msg=f"post-failover replication to {nid}",
+                )
+            # Healed old leader catches up.
+            net.heal(lead)
+            wait_until(
+                lambda: kvs[lead].lookup("after") == "2",
+                timeout=20.0,
+                msg="healed node catch-up",
+            )
+        finally:
+            stop_all(net, nodes)
+
+    def test_restart_replays_wal(self, tmp_path):
+        net, nodes, kvs = make_cluster(tmp_path)
+        try:
+            lead = wait_leader(nodes)
+            for i in range(20):
+                kvs[lead].propose(f"k{i}", f"v{i}")
+            victim = next(i for i in nodes if i != lead)
+            wait_until(
+                lambda: kvs[victim].lookup("k19") == "v19",
+                msg="replication before restart",
+            )
+            nodes[victim].stop()
+            # Restart from disk: WAL replay must restore all applied state.
+            kv2 = ReplicatedKV()
+            node2 = ExampleRaftNode(
+                node_id=victim,
+                peers=list(nodes),
+                network=net,
+                data_dir=str(tmp_path),
+                apply_fn=kv2.apply,
+                snapshot_fn=kv2.snapshot,
+                restore_fn=kv2.restore,
+                tick_interval=0.01,
+            )
+            kv2.attach(node2)
+            nodes[victim], kvs[victim] = node2, kv2
+            wait_until(
+                lambda: kv2.lookup("k19") == "v19",
+                timeout=20.0,
+                msg="state after WAL replay",
+            )
+        finally:
+            stop_all(net, nodes)
+
+    def test_snapshot_trigger_and_restore(self, tmp_path):
+        net, nodes, kvs = make_cluster(tmp_path, snap_count=20)
+        try:
+            lead = wait_leader(nodes)
+            for i in range(60):
+                kvs[lead].propose(f"k{i}", f"v{i}")
+            wait_until(
+                lambda: all(n.snapshot_index > 0 for n in nodes.values()),
+                timeout=20.0,
+                msg="snapshot trigger",
+            )
+            snapdir = os.path.join(str(tmp_path), f"member-{lead}", "snap")
+            assert any(f.endswith(".snap") for f in os.listdir(snapdir))
+            victim = next(i for i in nodes if i != lead)
+            nodes[victim].stop()
+            kv2 = ReplicatedKV()
+            node2 = ExampleRaftNode(
+                node_id=victim,
+                peers=list(nodes),
+                network=net,
+                data_dir=str(tmp_path),
+                apply_fn=kv2.apply,
+                snapshot_fn=kv2.snapshot,
+                restore_fn=kv2.restore,
+                snap_count=20,
+                tick_interval=0.01,
+            )
+            kv2.attach(node2)
+            nodes[victim], kvs[victim] = node2, kv2
+            wait_until(
+                lambda: kv2.lookup("k59") == "v59",
+                timeout=20.0,
+                msg="restore from snapshot + tail",
+            )
+        finally:
+            stop_all(net, nodes)
+
+
+class TestConfChange:
+    def test_add_then_remove_node(self, tmp_path):
+        net, nodes, kvs = make_cluster(tmp_path)
+        try:
+            lead = wait_leader(nodes)
+            kvs[lead].propose("seed", "x")
+            # Add node 4 as a joiner.
+            cc = ConfChange(
+                id=1, type=ConfChangeType.ConfChangeAddNode, node_id=4
+            )
+            nodes[lead].propose_conf_change(cc)
+            wait_until(
+                lambda: nodes[lead].confstate is not None
+                and 4 in nodes[lead].confstate.voters,
+                timeout=20.0,
+                msg="conf change applied on leader",
+            )
+            kv4 = ReplicatedKV()
+            node4 = ExampleRaftNode(
+                node_id=4,
+                peers=[1, 2, 3, 4],
+                network=net,
+                data_dir=str(tmp_path),
+                apply_fn=kv4.apply,
+                snapshot_fn=kv4.snapshot,
+                restore_fn=kv4.restore,
+                join=True,
+                tick_interval=0.01,
+            )
+            kv4.attach(node4)
+            nodes[4], kvs[4] = node4, kv4
+            wait_until(
+                lambda: kv4.lookup("seed") == "x",
+                timeout=20.0,
+                msg="new node catch-up",
+            )
+            # Remove it again; the removed node shuts itself down.
+            cc2 = ConfChange(
+                id=2, type=ConfChangeType.ConfChangeRemoveNode, node_id=4
+            )
+            nodes[lead].propose_conf_change(cc2)
+            wait_until(
+                lambda: node4._stopped.is_set(),
+                timeout=20.0,
+                msg="removed node self-stop",
+            )
+            kvs[lead].propose("post-remove", "y")
+            for nid in (1, 2, 3):
+                wait_until(
+                    lambda nid=nid: kvs[nid].lookup("post-remove") == "y",
+                    msg=f"cluster of 3 still live ({nid})",
+                )
+        finally:
+            stop_all(net, nodes)
