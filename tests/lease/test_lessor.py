@@ -1,0 +1,201 @@
+"""Lessor behavior tests (ref: server/lease/lessor_test.go — grant,
+revoke-deletes-keys, renew, attach/detach, promote/demote expiry
+gating, checkpoints, persistence across restart)."""
+
+import time
+
+import pytest
+
+from etcd_tpu.lease import (
+    FOREVER,
+    Lease,
+    LeaseExistsError,
+    LeaseItem,
+    LeaseNotFoundError,
+    Lessor,
+    NoLease,
+)
+from etcd_tpu.storage import backend as bk
+
+
+@pytest.fixture
+def be(tmp_path):
+    b = bk.open_backend(str(tmp_path / "be.db"))
+    yield b
+    b.close()
+
+
+def new_lessor(be, **kw):
+    kw.setdefault("min_lease_ttl", 1)
+    kw.setdefault("loop_interval", 0.02)
+    le = Lessor(be, **kw)
+    return le
+
+
+class FakeTxn:
+    """Captures revoke-time key deletes (ref: lessor_test.go fakeDeleter)."""
+
+    def __init__(self):
+        self.deleted = []
+        self.ended = False
+
+    def delete_range(self, key, end):
+        self.deleted.append((key, end))
+
+    def end(self):
+        self.ended = True
+
+
+class TestGrantRevoke:
+    def test_grant_and_lookup(self, be):
+        le = new_lessor(be)
+        l = le.grant(1, 10)
+        assert l.id == 1 and l.ttl == 10
+        assert le.lookup(1) is l
+        with pytest.raises(LeaseExistsError):
+            le.grant(1, 10)
+        le.stop()
+
+    def test_grant_ttl_floor(self, be):
+        le = new_lessor(be, min_lease_ttl=5)
+        l = le.grant(1, 1)
+        assert l.ttl == 5  # clamped up to minLeaseTTL
+        le.stop()
+
+    def test_revoke_deletes_attached_keys(self, be):
+        le = new_lessor(be)
+        txn = FakeTxn()
+        le.range_deleter = lambda: txn
+        le.grant(7, 10)
+        le.attach(7, [LeaseItem("foo"), LeaseItem("bar")])
+        assert le.get_lease(LeaseItem("foo")) == 7
+        le.revoke(7)
+        assert sorted(k for k, _ in txn.deleted) == [b"bar", b"foo"]
+        assert txn.ended
+        assert le.lookup(7) is None
+        assert le.get_lease(LeaseItem("foo")) == NoLease
+        le.stop()
+
+    def test_revoke_unknown(self, be):
+        le = new_lessor(be)
+        with pytest.raises(LeaseNotFoundError):
+            le.revoke(99)
+        le.stop()
+
+
+class TestExpiry:
+    def test_not_primary_never_expires(self, be):
+        le = new_lessor(be)
+        le.grant(1, 1)
+        assert le.lookup(1).remaining() == FOREVER
+        assert le.expired_leases(timeout=0.3) == []
+        le.stop()
+
+    def test_primary_expires_after_ttl(self, be):
+        le = new_lessor(be)
+        le.promote()
+        le.grant(1, 1)
+        assert 0 < le.lookup(1).remaining() <= 1.0
+        expired = le.expired_leases(timeout=5.0)
+        assert [l.id for l in expired] == [1]
+        le.stop()
+
+    def test_renew_extends(self, be):
+        le = new_lessor(be)
+        le.promote()
+        le.grant(1, 1)
+        deadline = time.monotonic() + 0.8
+        while time.monotonic() < deadline:
+            assert le.renew(1) == 1
+            time.sleep(0.05)
+        # Renewed throughout: nothing should have surfaced as expired.
+        assert le.expired_leases(timeout=0.05) == []
+        le.stop()
+
+    def test_renew_requires_primary(self, be):
+        le = new_lessor(be)
+        le.grant(1, 10)
+        with pytest.raises(LeaseNotFoundError):
+            le.renew(1)
+        le.stop()
+
+    def test_demote_parks_expiry(self, be):
+        le = new_lessor(be)
+        le.promote()
+        le.grant(1, 1)
+        le.demote()
+        assert le.lookup(1).remaining() == FOREVER
+        assert le.expired_leases(timeout=0.3) == []
+        le.stop()
+
+    def test_promote_extend_grace(self, be):
+        le = new_lessor(be)
+        le.grant(1, 2)
+        le.promote(extend=3.0)
+        rem = le.lookup(1).remaining()
+        assert 4.0 < rem <= 5.0  # ttl + extend
+        le.stop()
+
+
+class TestCheckpoint:
+    def test_checkpoint_shrinks_remaining(self, be):
+        le = new_lessor(be)
+        le.promote()
+        le.grant(1, 100)
+        le.checkpoint(1, 30)
+        lease = le.lookup(1)
+        assert lease.remaining_ttl == 30
+        assert lease.remaining() <= 30.0
+        le.stop()
+
+    def test_checkpointer_called_for_long_leases(self, be):
+        calls = []
+        le = new_lessor(be, checkpoint_interval=0.1)
+        le.checkpointer = lambda lid, rem: calls.append((lid, rem))
+        le.promote()
+        le.grant(1, 100)
+        deadline = time.monotonic() + 3.0
+        while not calls and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert calls and calls[0][0] == 1
+        assert 0 <= calls[0][1] <= 100
+        le.stop()
+
+    def test_renew_clears_checkpoint(self, be):
+        le = new_lessor(be)
+        le.checkpointer = lambda lid, rem: None
+        le.promote()
+        le.grant(1, 100)
+        le.checkpoint(1, 30)
+        le.renew(1)
+        assert le.lookup(1).remaining_ttl == 0
+        assert le.lookup(1).remaining() > 30
+        le.stop()
+
+
+class TestPersistence:
+    def test_leases_survive_restart(self, be, tmp_path):
+        le = new_lessor(be)
+        le.grant(1, 10)
+        le.grant(2, 20)
+        le.attach(1, [LeaseItem("k")])
+        le.stop()
+        be.force_commit()
+
+        le2 = new_lessor(be)
+        assert {l.id for l in le2.leases()} == {1, 2}
+        assert le2.lookup(2).ttl == 20
+        # Expiry is parked until promotion after recovery.
+        assert le2.lookup(1).remaining() == FOREVER
+        le2.stop()
+
+    def test_checkpoint_persist(self, be):
+        le = new_lessor(be, checkpoint_persist=True)
+        le.promote()
+        le.grant(1, 100)
+        le.checkpoint(1, 25)
+        le.stop()
+        be.force_commit()
+        le2 = new_lessor(be, checkpoint_persist=True)
+        assert le2.lookup(1).remaining_ttl == 25
+        le2.stop()
