@@ -72,6 +72,21 @@ class LeaseItem:
     key: str
 
 
+def _as_items(items) -> List["LeaseItem"]:
+    if isinstance(items, LeaseItem):
+        return [items]
+    if isinstance(items, bytes):
+        return [LeaseItem(items.decode("latin1"))]
+    if isinstance(items, str):
+        return [LeaseItem(items)]
+    return [
+        it if isinstance(it, LeaseItem) else LeaseItem(
+            it.decode("latin1") if isinstance(it, bytes) else it
+        )
+        for it in items
+    ]
+
+
 class Lease:
     """ref: lessor.go:831-905 Lease."""
 
@@ -210,7 +225,7 @@ class Lessor:
         txn = self.range_deleter() if self.range_deleter is not None else None
         if txn is not None:
             for key in keys:
-                txn.delete_range(key.encode(), None)
+                txn.delete_range(key.encode("latin1"), None)
         with self._lock:
             self.lease_map.pop(lease_id, None)
             for it in list(lease.item_set):
@@ -263,25 +278,26 @@ class Lessor:
 
     # -- attach / detach -------------------------------------------------------
 
-    def attach(self, lease_id: int, items: List[LeaseItem]) -> None:
-        """ref: lessor.go:532-556."""
+    def attach(self, lease_id: int, items) -> None:
+        """ref: lessor.go:532-556. `items`: List[LeaseItem] or a single
+        key (bytes/str) — the mvcc write txn passes raw keys."""
         with self._lock:
             lease = self.lease_map.get(lease_id)
             if lease is None:
                 raise LeaseNotFoundError(str(lease_id))
             with lease._items_lock:
-                for it in items:
+                for it in _as_items(items):
                     lease.item_set.add(it)
                     self.item_map[it] = lease_id
 
-    def detach(self, lease_id: int, items: List[LeaseItem]) -> None:
+    def detach(self, lease_id: int, items) -> None:
         """ref: lessor.go:565-583."""
         with self._lock:
             lease = self.lease_map.get(lease_id)
             if lease is None:
                 raise LeaseNotFoundError(str(lease_id))
             with lease._items_lock:
-                for it in items:
+                for it in _as_items(items):
                     lease.item_set.discard(it)
                     self.item_map.pop(it, None)
 

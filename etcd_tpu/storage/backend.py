@@ -282,16 +282,22 @@ class Backend:
 
     def defrag(self) -> None:
         with self.batch_tx.lock:
-            self._commit_locked()
+            self.batch_tx.commit()
             with self._wlock:
                 self._w.execute("PRAGMA wal_checkpoint(TRUNCATE)")
                 self._w.execute("VACUUM")
 
     def size(self) -> int:
-        try:
-            return os.path.getsize(self.path)
-        except OSError:
-            return 0
+        """On-disk footprint incl. the not-yet-checkpointed WAL journal
+        (bbolt's size is the whole mmap'd file; counting the sqlite -wal
+        keeps quota checks honest before checkpoints)."""
+        total = 0
+        for p in (self.path, self.path + "-wal"):
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                pass
+        return total
 
     def size_in_use(self) -> int:
         with self._wlock:
@@ -304,7 +310,7 @@ class Backend:
         """Consistent online copy (the reference streams the bbolt file;
         sqlite3's backup API gives the same guarantee)."""
         with self.batch_tx.lock:
-            self._commit_locked()
+            self.batch_tx.commit()
             with self._wlock:
                 dst = sqlite3.connect(dest_path)
                 try:
@@ -316,7 +322,9 @@ class Backend:
         self._stopped.set()
         self._runner.join(timeout=5)
         with self.batch_tx.lock:
-            self._commit_locked()
+            # Through the hook-running commit: the consistent index must
+            # land in the same final txn as the buffered applies.
+            self.batch_tx.commit()
             with self._wlock:
                 self._w.close()
             with self._rlock:

@@ -1,0 +1,419 @@
+"""EtcdServer integration tests: in-proc members over a fault-injectable
+network (harness shape per tests/framework/integration/cluster.go;
+behaviors per server/etcdserver tests)."""
+
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.raftexample.transport import InProcNetwork
+from etcd_tpu.server import EtcdServer, ServerConfig
+from etcd_tpu.server.api import (
+    AlarmAction,
+    AlarmRequest,
+    AlarmType,
+    AuthRequest,
+    Compare,
+    CompareResult,
+    CompareTarget,
+    CompactionRequest,
+    DeleteRangeRequest,
+    PutRequest,
+    RangeRequest,
+    RequestOp,
+    SortOrder,
+    SortTarget,
+    TxnRequest,
+)
+from etcd_tpu.server.apply import NoSpaceError
+from etcd_tpu.server.membership import Member
+from etcd_tpu.storage.mvcc.kvstore import CompactedError
+
+
+def wait_until(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_cluster(tmp_path, n=3, **cfg_kw):
+    net = InProcNetwork()
+    peers = list(range(1, n + 1))
+    servers = {}
+    for nid in peers:
+        servers[nid] = EtcdServer(
+            ServerConfig(
+                member_id=nid,
+                peers=peers,
+                data_dir=str(tmp_path),
+                network=net,
+                tick_interval=0.01,
+                request_timeout=10.0,
+                **cfg_kw,
+            )
+        )
+    return net, servers
+
+
+def wait_leader(servers, timeout=15.0):
+    box = {}
+
+    def has_leader():
+        for s in servers.values():
+            if s.is_leader():
+                box["lead"] = s.id
+                return True
+        return False
+
+    wait_until(has_leader, timeout=timeout, msg="leader election")
+    return box["lead"]
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    net, servers = make_cluster(tmp_path, 3)
+    lead = wait_leader(servers)
+    yield net, servers, lead
+    for s in servers.values():
+        s.stop()
+    net.stop()
+
+
+@pytest.fixture
+def single(tmp_path):
+    net, servers = make_cluster(tmp_path, 1)
+    wait_leader(servers)
+    yield servers[1]
+    servers[1].stop()
+    net.stop()
+
+
+class TestKV:
+    def test_put_range_any_member(self, cluster3):
+        _net, servers, lead = cluster3
+        follower = next(i for i in servers if i != lead)
+        servers[follower].put(PutRequest(key=b"k", value=b"v"))
+        # Linearizable read from another follower sees it immediately.
+        other = next(i for i in servers if i not in (lead, follower))
+        rr = servers[other].range(RangeRequest(key=b"k"))
+        assert rr.kvs and rr.kvs[0].value == b"v"
+
+    def test_serializable_vs_linearizable(self, single):
+        single.put(PutRequest(key=b"a", value=b"1"))
+        rr = single.range(RangeRequest(key=b"a", serializable=True))
+        assert rr.kvs[0].value == b"1"
+
+    def test_range_sort_limit_prefix(self, single):
+        for i in range(5):
+            single.put(PutRequest(key=b"k%d" % i, value=b"v%d" % i))
+        rr = single.range(
+            RangeRequest(
+                key=b"k",
+                range_end=b"l",
+                sort_order=SortOrder.DESCEND,
+                sort_target=SortTarget.KEY,
+                limit=3,
+            )
+        )
+        assert [kv.key for kv in rr.kvs] == [b"k4", b"k3", b"k2"]
+        assert rr.more
+
+    def test_delete_range_prev_kv(self, single):
+        single.put(PutRequest(key=b"x1", value=b"a"))
+        single.put(PutRequest(key=b"x2", value=b"b"))
+        dr = single.delete_range(
+            DeleteRangeRequest(key=b"x", range_end=b"y", prev_kv=True)
+        )
+        assert dr.deleted == 2
+        assert sorted(kv.key for kv in dr.prev_kvs) == [b"x1", b"x2"]
+
+    def test_put_prev_kv_and_ignore_value(self, single):
+        single.put(PutRequest(key=b"p", value=b"old"))
+        resp = single.put(PutRequest(key=b"p", value=b"new", prev_kv=True))
+        assert resp.prev_kv is not None and resp.prev_kv.value == b"old"
+        single.put(PutRequest(key=b"p", ignore_value=True, lease=0))
+        rr = single.range(RangeRequest(key=b"p"))
+        assert rr.kvs[0].value == b"new"
+
+    def test_txn_compare_success_failure(self, single):
+        single.put(PutRequest(key=b"t", value=b"1"))
+        resp = single.txn(
+            TxnRequest(
+                compare=[
+                    Compare(
+                        result=CompareResult.EQUAL,
+                        target=CompareTarget.VALUE,
+                        key=b"t",
+                        value=b"1",
+                    )
+                ],
+                success=[RequestOp(request_put=PutRequest(key=b"t", value=b"2"))],
+                failure=[RequestOp(request_put=PutRequest(key=b"t", value=b"9"))],
+            )
+        )
+        assert resp.succeeded
+        assert single.range(RangeRequest(key=b"t")).kvs[0].value == b"2"
+        resp = single.txn(
+            TxnRequest(
+                compare=[
+                    Compare(
+                        result=CompareResult.EQUAL,
+                        target=CompareTarget.VALUE,
+                        key=b"t",
+                        value=b"1",
+                    )
+                ],
+                success=[RequestOp(request_put=PutRequest(key=b"t", value=b"3"))],
+                failure=[RequestOp(request_put=PutRequest(key=b"t", value=b"9"))],
+            )
+        )
+        assert not resp.succeeded
+        assert single.range(RangeRequest(key=b"t")).kvs[0].value == b"9"
+
+    def test_readonly_txn(self, single):
+        single.put(PutRequest(key=b"r", value=b"v"))
+        resp = single.txn(
+            TxnRequest(
+                compare=[],
+                success=[RequestOp(request_range=RangeRequest(key=b"r"))],
+            )
+        )
+        assert resp.succeeded
+        assert resp.responses[0].response_range.kvs[0].value == b"v"
+
+    def test_compaction(self, single):
+        for i in range(5):
+            single.put(PutRequest(key=b"c", value=b"v%d" % i))
+        rev = single.kv.rev()
+        single.compact(CompactionRequest(revision=rev - 1))
+        with pytest.raises(CompactedError):
+            single.range(RangeRequest(key=b"c", revision=rev - 2))
+        assert single.range(RangeRequest(key=b"c")).kvs[0].value == b"v4"
+
+
+class TestLease:
+    def test_grant_put_expire_revokes_key(self, single):
+        g = single.lease_grant(ttl=1)
+        single.put(PutRequest(key=b"leased", value=b"v", lease=g.id))
+        ttl = single.lease_time_to_live(g.id, keys=True)
+        assert ttl["keys"] == ["leased"]
+        wait_until(
+            lambda: not single.range(RangeRequest(key=b"leased")).kvs,
+            timeout=15.0,
+            msg="lease expiry deletes key",
+        )
+        assert single.lessor.lookup(g.id) is None
+
+    def test_renew_keeps_alive(self, single):
+        g = single.lease_grant(ttl=1)
+        single.put(PutRequest(key=b"ka", value=b"v", lease=g.id))
+        deadline = time.monotonic() + 2.5
+        while time.monotonic() < deadline:
+            single.lease_renew(g.id)
+            time.sleep(0.2)
+        assert single.range(RangeRequest(key=b"ka")).kvs
+
+    def test_revoke_deletes_keys(self, single):
+        g = single.lease_grant(ttl=60)
+        single.put(PutRequest(key=b"rv", value=b"v", lease=g.id))
+        single.lease_revoke(g.id)
+        assert not single.range(RangeRequest(key=b"rv")).kvs
+
+    def test_grant_replicated(self, cluster3):
+        _net, servers, lead = cluster3
+        g = servers[lead].lease_grant(ttl=60)
+        for s in servers.values():
+            wait_until(
+                lambda s=s: s.lessor.lookup(g.id) is not None,
+                msg=f"lease replicated to {s.id}",
+            )
+
+
+class TestAlarmsQuota:
+    def test_nospace_alarm_blocks_writes(self, tmp_path):
+        net, servers = make_cluster(tmp_path, 1, quota_bytes=200_000)
+        try:
+            wait_leader(servers)
+            s = servers[1]
+            big = b"x" * 60_000
+            with pytest.raises(NoSpaceError):
+                for i in range(40):
+                    s.put(PutRequest(key=b"big%d" % i, value=big))
+            wait_until(
+                lambda: AlarmType.NOSPACE in s.alarms.active_types(),
+                msg="NOSPACE alarm raised",
+            )
+            with pytest.raises(NoSpaceError):
+                s.put(PutRequest(key=b"after", value=b"v"))
+            # Reads still work under NOSPACE.
+            s.range(RangeRequest(key=b"big0"))
+            # Disarm → writes resume.
+            s.alarm(
+                AlarmRequest(
+                    action=AlarmAction.DEACTIVATE,
+                    member_id=1,
+                    alarm=AlarmType.NOSPACE,
+                )
+            )
+            s.cfg.quota_bytes = 1 << 40
+            s.put(PutRequest(key=b"after", value=b"v"))
+        finally:
+            for s in servers.values():
+                s.stop()
+            net.stop()
+
+
+class TestAuth:
+    def test_auth_flow_over_raft(self, single):
+        s = single
+        s.auth_op(AuthRequest(op="user_add", name="root", password="pw"))
+        s.auth_op(AuthRequest(op="user_grant_role", name="root", role="root"))
+        s.auth_enable()
+        assert s.auth_store.is_auth_enabled()
+        root_token = s.authenticate("root", "pw")
+        s.auth_op(
+            AuthRequest(op="user_add", name="alice", password="ap"),
+            token=root_token,
+        )
+        s.auth_op(AuthRequest(op="role_add", role="r"), token=root_token)
+        s.auth_op(
+            AuthRequest(
+                op="role_grant_permission",
+                role="r",
+                key=b"/a/",
+                range_end=b"/a0",
+                perm_type=2,
+            ),
+            token=root_token,
+        )
+        s.auth_op(
+            AuthRequest(op="user_grant_role", name="alice", role="r"),
+            token=root_token,
+        )
+        alice = s.authenticate("alice", "ap")
+        s.put(PutRequest(key=b"/a/x", value=b"1"), token=alice)
+        from etcd_tpu.auth import PermissionDeniedError
+
+        with pytest.raises(PermissionDeniedError):
+            s.put(PutRequest(key=b"/b/x", value=b"1"), token=alice)
+        rr = s.range(RangeRequest(key=b"/a/x"), token=alice)
+        assert rr.kvs[0].value == b"1"
+
+
+class TestMembership:
+    def test_member_list_bootstrapped(self, cluster3):
+        _net, servers, lead = cluster3
+        wait_until(
+            lambda: all(len(s.cluster.member_list()) == 3 for s in servers.values()),
+            msg="bootstrap members applied",
+        )
+
+    def test_add_remove_member(self, tmp_path):
+        net, servers = make_cluster(tmp_path, 3)
+        try:
+            lead = wait_leader(servers)
+            servers[lead].add_member(Member(id=4, name="m4"))
+            wait_until(
+                lambda: all(
+                    4 in s.cluster.member_ids() for s in servers.values()
+                ),
+                msg="member add replicated",
+            )
+            s4 = EtcdServer(
+                ServerConfig(
+                    member_id=4,
+                    peers=[1, 2, 3, 4],
+                    data_dir=str(tmp_path),
+                    network=net,
+                    join=True,
+                    tick_interval=0.01,
+                    request_timeout=10.0,
+                )
+            )
+            servers[4] = s4
+            servers[lead].put(PutRequest(key=b"mm", value=b"vv"))
+            wait_until(
+                lambda: s4.range(
+                    RangeRequest(key=b"mm", serializable=True)
+                ).kvs,
+                timeout=20.0,
+                msg="new member catch-up",
+            )
+            servers[lead].remove_member(4)
+            wait_until(
+                lambda: 4 not in servers[lead].cluster.member_ids(),
+                msg="member removed",
+            )
+            wait_until(
+                lambda: s4._stopped.is_set(),
+                timeout=20.0,
+                msg="removed member self-stop",
+            )
+        finally:
+            for s in servers.values():
+                s.stop()
+            net.stop()
+
+
+class TestRestart:
+    def test_restart_exactly_once_apply(self, tmp_path):
+        net, servers = make_cluster(tmp_path, 1)
+        wait_leader(servers)
+        s = servers[1]
+        for i in range(10):
+            s.put(PutRequest(key=b"k%d" % i, value=b"v%d" % i))
+        rev = s.kv.rev()
+        s.stop()
+        net.stop()
+
+        net2 = InProcNetwork()
+        s2 = EtcdServer(
+            ServerConfig(
+                member_id=1,
+                peers=[1],
+                data_dir=str(tmp_path),
+                network=net2,
+                tick_interval=0.01,
+                request_timeout=10.0,
+            )
+        )
+        try:
+            wait_until(s2.is_leader, msg="re-election after restart")
+            # Replayed WAL entries must not double-apply: revision unchanged.
+            assert s2.kv.rev() == rev
+            rr = s2.range(RangeRequest(key=b"k9"))
+            assert rr.kvs[0].value == b"v9"
+        finally:
+            s2.stop()
+            net2.stop()
+
+    def test_snapshot_catchup_lagging_member(self, tmp_path):
+        net, servers = make_cluster(tmp_path, 3, snapshot_count=20,
+                                    snapshot_catchup_entries=5)
+        try:
+            lead = wait_leader(servers)
+            lagger = next(i for i in servers if i != lead)
+            net.isolate(lagger)
+            for i in range(60):
+                servers[lead].put(PutRequest(key=b"s%d" % i, value=b"v"))
+            wait_until(
+                lambda: servers[lead]._snapshot_index() > 0,
+                timeout=20.0,
+                msg="leader snapshot trigger",
+            )
+            net.heal(lagger)
+            wait_until(
+                lambda: servers[lagger].range(
+                    RangeRequest(key=b"s59", serializable=True)
+                ).kvs,
+                timeout=30.0,
+                msg="lagging member snapshot catch-up",
+            )
+        finally:
+            for s in servers.values():
+                s.stop()
+            net.stop()
