@@ -1,0 +1,85 @@
+"""Fault-proxy tests (ref: pkg/proxy/server_test.go behaviors) — and a
+cluster whose peer links ride through proxies (the functional harness
+shape: blackhole a member, watch the cluster keep going)."""
+
+import time
+
+from etcd_tpu.pkg.proxy import ProxyServer
+from etcd_tpu.raft.types import Message, MessageType
+from etcd_tpu.transport import TCPTransport
+
+
+def wait_until(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_forward_and_blackhole():
+    t2 = TCPTransport(member_id=2, cluster_id=1)
+    got = []
+    t2.register(2, got.append)
+    proxy = ProxyServer(("127.0.0.1", 0), t2.addr)
+    t1 = TCPTransport(member_id=1, cluster_id=1)
+    t1.add_peer(2, proxy.addr)
+
+    t1.send(1, [Message(type=MessageType.MsgHeartbeat, to=2, from_=1, index=1)])
+    wait_until(lambda: len(got) == 1, msg="forward through proxy")
+
+    proxy.blackhole()
+    t1.send(1, [Message(type=MessageType.MsgHeartbeat, to=2, from_=1, index=2)])
+    time.sleep(0.3)
+    assert len(got) == 1
+
+    proxy.unblackhole()
+    t1.send(1, [Message(type=MessageType.MsgHeartbeat, to=2, from_=1, index=3)])
+    wait_until(lambda: len(got) >= 2, msg="delivery after unblackhole")
+
+    t1.stop()
+    t2.stop()
+    proxy.stop()
+
+
+def test_delay():
+    t2 = TCPTransport(member_id=2, cluster_id=1)
+    got = []
+    t2.register(2, got.append)
+    proxy = ProxyServer(("127.0.0.1", 0), t2.addr)
+    proxy.delay_tx(0.3)
+    t1 = TCPTransport(member_id=1, cluster_id=1)
+    t1.add_peer(2, proxy.addr)
+
+    start = time.monotonic()
+    t1.send(1, [Message(type=MessageType.MsgHeartbeat, to=2, from_=1)])
+    wait_until(lambda: got, msg="delayed delivery")
+    assert time.monotonic() - start >= 0.25
+
+    t1.stop()
+    t2.stop()
+    proxy.stop()
+
+
+def test_reset_listen_kills_conns_then_recovers():
+    t2 = TCPTransport(member_id=2, cluster_id=1)
+    got = []
+    t2.register(2, got.append)
+    proxy = ProxyServer(("127.0.0.1", 0), t2.addr)
+    t1 = TCPTransport(member_id=1, cluster_id=1)
+    t1.add_peer(2, proxy.addr)
+    t1.send(1, [Message(type=MessageType.MsgHeartbeat, to=2, from_=1, index=1)])
+    wait_until(lambda: len(got) == 1, msg="pre-reset delivery")
+
+    proxy.reset_listen()
+    # The stream reconnects through the proxy on subsequent sends.
+    deadline = time.monotonic() + 10
+    while len(got) < 2 and time.monotonic() < deadline:
+        t1.send(1, [Message(type=MessageType.MsgHeartbeat, to=2, from_=1, index=2)])
+        time.sleep(0.05)
+    assert len(got) >= 2
+
+    t1.stop()
+    t2.stop()
+    proxy.stop()
