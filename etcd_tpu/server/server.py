@@ -226,6 +226,7 @@ class EtcdServer:
             loop_interval=min(0.5, self.cfg.tick_interval * 4),
         )
         self.kv = WatchableStore(self.be, self.lessor)
+        self.kv.start_sync_loop()
         self.auth_store = AuthStore(self.be, token_provider=SimpleTokenProvider())
         self.alarms = AlarmStore(self.be)
         self.cluster = RaftCluster(self.cluster_id, self.be)
@@ -404,7 +405,7 @@ class EtcdServer:
             f.flush()
             os.fsync(f.fileno())
         # Tear down stores over the old backend, swap the file, reopen.
-        self.kv.close() if hasattr(self.kv, "close") else None
+        self.kv.stop_sync_loop()
         self.lessor.stop()
         self.be.close()
         os.replace(newdb, self.db_path)
@@ -870,6 +871,7 @@ class EtcdServer:
             self.compactor.stop()
         self.node.stop()
         self.sched.stop()
+        self.kv.stop_sync_loop()
         self.lessor.stop()
         self.wal.close()
         self.be.close()

@@ -249,6 +249,28 @@ class WatchableStore(KVStore):
                 self.synced.add(w)
             return len(self.unsynced)
 
+    def start_sync_loop(self, interval: float = 0.1) -> None:
+        """The unsynced catch-up + victim retry loop
+        (ref: watchable_store.go:211 syncWatchersLoop, every 100ms)."""
+        if getattr(self, "_sync_stop", None) is not None:
+            return
+        self._sync_stop = threading.Event()
+
+        def loop() -> None:
+            while not self._sync_stop.wait(interval):
+                try:
+                    self.sync_watchers()
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    pass
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    def stop_sync_loop(self) -> None:
+        stop = getattr(self, "_sync_stop", None)
+        if stop is not None:
+            stop.set()
+            self._sync_stop = None
+
     def _retry_victims(self) -> None:
         still: List[Tuple[Watcher, List[Event]]] = []
         for w, evs in self._victims:

@@ -1,0 +1,279 @@
+"""The RPC server: six service surfaces over one listener
+(ref: api/v3rpc/grpc.go:39-93 service registration; key.go, watch.go,
+lease.go, maintenance.go, member.go, auth.go).
+
+Connection model: one read loop per client conn; unary methods run on
+worker threads (gRPC handler goroutines); each conn owns one
+WatchStream whose poller pushes ``{"stream": wid, "event": ...}``
+frames (watch.go's sendLoop/recvLoop pair).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+from ..server import api as sapi
+from ..server.membership import Member
+from . import wire
+
+
+class V3RPCServer:
+    def __init__(self, server, bind=("127.0.0.1", 0)) -> None:
+        self.s = server
+        self._stopped = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(bind)
+        self._listener.listen(128)
+        self.addr = self._listener.getsockname()
+        self._conns: list = []
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for c in list(self._conns):
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            _Conn(self, conn)
+
+
+class _Conn:
+    def __init__(self, srv: V3RPCServer, sock: socket.socket) -> None:
+        self.srv = srv
+        self.sock = sock
+        self.wlock = threading.Lock()
+        self.watch_stream = None
+        self._watch_poller: Optional[threading.Thread] = None
+        threading.Thread(target=self._read_loop, daemon=True).start()
+
+    def _send(self, obj: Dict[str, Any]) -> bool:
+        try:
+            with self.wlock:
+                wire.write_frame(self.sock, obj)
+            return True
+        except OSError:
+            return False
+
+    def _read_loop(self) -> None:
+        try:
+            while not self.srv._stopped.is_set():
+                req = wire.read_frame(self.sock)
+                if req is None:
+                    return
+                threading.Thread(
+                    target=self._handle, args=(req,), daemon=True
+                ).start()
+        finally:
+            if self.watch_stream is not None:
+                self.watch_stream.close()
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def _handle(self, req: Dict[str, Any]) -> None:
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params", {}) or {}
+        token = req.get("token")
+        try:
+            result = self._dispatch(method, params, token)
+            self._send({"id": rid, "result": wire.enc(result)})
+        except Exception as e:  # noqa: BLE001 — typed error to the client
+            self._send(
+                {
+                    "id": rid,
+                    "error": {"type": type(e).__name__, "msg": str(e)},
+                }
+            )
+
+    # -- dispatch --------------------------------------------------------------
+
+    def _dispatch(self, method: str, params: Dict, token: Optional[str]):
+        s = self.srv.s
+        if method in ("Range", "Put", "DeleteRange", "Txn", "Compact"):
+            req = wire.dec_request(method, params)
+            fn = {
+                "Range": s.range,
+                "Put": s.put,
+                "DeleteRange": s.delete_range,
+                "Txn": s.txn,
+                "Compact": s.compact,
+            }[method]
+            return fn(req, token=token)
+
+        if method == "Alarm":
+            req = wire.dec_request("Alarm", params)
+            return s.alarm(req, token=token)
+
+        if method == "WatchCreate":
+            return self._watch_create(params)
+        if method == "WatchCancel":
+            if self.watch_stream is not None:
+                self.watch_stream.cancel(params["watch_id"])
+            return {"canceled": True}
+
+        if method == "LeaseGrant":
+            return s.lease_grant(
+                ttl=params["ttl"], lease_id=params.get("id", 0), token=token
+            )
+        if method == "LeaseRevoke":
+            return s.lease_revoke(params["id"], token=token)
+        if method == "LeaseKeepAlive":
+            ttl = s.lease_renew(params["id"])
+            return {"id": params["id"], "ttl": ttl}
+        if method == "LeaseTimeToLive":
+            out = s.lease_time_to_live(params["id"], keys=params.get("keys", False))
+            if out is None:
+                return {"id": params["id"], "ttl": -1}
+            return out
+        if method == "LeaseLeases":
+            return {"leases": s.lease_leases()}
+
+        if method == "MemberAdd":
+            m = Member(
+                id=params["id"],
+                name=params.get("name", ""),
+                peer_urls=params.get("peer_urls", []),
+                is_learner=params.get("is_learner", False),
+            )
+            s.add_member(m)
+            return {"members": [wire.enc(x.__dict__) for x in s.cluster.member_list()]}
+        if method == "MemberRemove":
+            s.remove_member(params["id"])
+            return {"members": [wire.enc(x.__dict__) for x in s.cluster.member_list()]}
+        if method == "MemberPromote":
+            s.promote_member(params["id"])
+            return {"members": [wire.enc(x.__dict__) for x in s.cluster.member_list()]}
+        if method == "MemberList":
+            return {"members": [wire.enc(x.__dict__) for x in s.cluster.member_list()]}
+
+        if method == "Status":
+            return {
+                "member_id": s.id,
+                "leader": s.leader(),
+                "is_leader": s.is_leader(),
+                "raft_term": s._term,
+                "applied_index": s.applied_index(),
+                "committed_index": s.committed_index(),
+                "db_size": s.be.size(),
+                "db_size_in_use": s.be.size_in_use(),
+                "revision": s.kv.rev(),
+            }
+        if method == "HashKV":
+            h, crev, rev = s.hash_kv(params.get("revision", 0))
+            return {"hash": h, "compact_revision": crev, "revision": rev}
+        if method == "Defragment":
+            s.defrag()
+            return {}
+        if method == "MoveLeader":
+            s.node.transfer_leadership(s.leader(), params["target_id"])
+            return {}
+        if method == "Snapshot":
+            import os
+            import tempfile
+
+            fd, tmp = tempfile.mkstemp(suffix=".snap.db")
+            os.close(fd)
+            s.be.snapshot_to(tmp)
+            with open(tmp, "rb") as f:
+                data = f.read()
+            os.remove(tmp)
+            return {"blob": data.hex()}
+
+        if method == "Authenticate":
+            token_out = s.authenticate(params["name"], params["password"])
+            return {"token": token_out}
+        if method == "Auth":
+            req = wire.dec_request("Auth", params)
+            resp = s.auth_op(req, token=token)
+            return resp or {}
+        if method == "AuthStatus":
+            return {
+                "enabled": s.auth_store.is_auth_enabled(),
+                "auth_revision": s.auth_store.revision(),
+            }
+        if method == "UserGet":
+            u = s.auth_store.user_get(params["name"])
+            return {"name": u.name, "roles": u.roles}
+        if method == "UserList":
+            return {"users": s.auth_store.user_list()}
+        if method == "RoleGet":
+            r = s.auth_store.role_get(params["role"])
+            return {
+                "name": r.name,
+                "perms": [
+                    {
+                        "type": int(p.perm_type),
+                        "key": p.key.hex(),
+                        "range_end": p.range_end.hex(),
+                    }
+                    for p in r.key_permissions
+                ],
+            }
+        if method == "RoleList":
+            return {"roles": s.auth_store.role_list()}
+
+        raise ValueError(f"unknown method {method!r}")
+
+    # -- watch (watch.go stream loops) ----------------------------------------
+
+    def _watch_create(self, params: Dict) -> Dict:
+        s = self.srv.s
+        if self.watch_stream is None:
+            self.watch_stream = s.kv.new_watch_stream()
+            self._watch_poller = threading.Thread(
+                target=self._watch_push_loop, daemon=True
+            )
+            self._watch_poller.start()
+        key = bytes.fromhex(params["key"])
+        end_hex = params.get("range_end", "")
+        end = bytes.fromhex(end_hex) if end_hex else None
+        wid = self.watch_stream.watch(
+            key, end, start_rev=params.get("start_revision", 0)
+        )
+        return {"watch_id": wid, "revision": s.kv.rev()}
+
+    def _watch_push_loop(self) -> None:
+        ws = self.watch_stream
+        while not self.srv._stopped.is_set():
+            resp = ws.poll(timeout=0.2)
+            if resp is None:
+                continue
+            ok = self._send(
+                {
+                    "stream": resp.watch_id,
+                    "event": {
+                        "revision": resp.revision,
+                        "events": [wire.enc_event(ev) for ev in resp.events],
+                    },
+                }
+            )
+            if not ok:
+                return

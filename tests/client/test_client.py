@@ -1,0 +1,311 @@
+"""Client tests over the v3rpc wire (ref: client/v3 integration tests +
+concurrency recipe tests)."""
+
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.client import Client, ClientError
+from etcd_tpu.client.concurrency import STM, Election, Mutex, Session
+from etcd_tpu.raftexample.transport import InProcNetwork
+from etcd_tpu.server import EtcdServer, ServerConfig
+from etcd_tpu.server import api as sapi
+from etcd_tpu.storage.mvcc.kv import EventType
+from etcd_tpu.v3rpc import V3RPCServer
+
+
+def wait_until(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """3 servers, 3 rpc endpoints, one client over all of them."""
+    net = InProcNetwork()
+    servers, rpcs = {}, {}
+    for nid in (1, 2, 3):
+        servers[nid] = EtcdServer(
+            ServerConfig(
+                member_id=nid,
+                peers=[1, 2, 3],
+                data_dir=str(tmp_path),
+                network=net,
+                tick_interval=0.01,
+                request_timeout=10.0,
+            )
+        )
+        rpcs[nid] = V3RPCServer(servers[nid])
+    wait_until(
+        lambda: any(s.is_leader() for s in servers.values()),
+        timeout=15.0,
+        msg="leader",
+    )
+    client = Client([rpcs[n].addr for n in (1, 2, 3)])
+    yield servers, rpcs, client
+    client.close()
+    for r in rpcs.values():
+        r.stop()
+    for s in servers.values():
+        s.stop()
+    net.stop()
+
+
+class TestKV:
+    def test_put_get_delete(self, cluster):
+        _servers, _rpcs, c = cluster
+        c.put(b"k", b"v")
+        rr = c.get(b"k")
+        assert rr.kvs[0].value == b"v"
+        assert rr.count == 1
+        c.delete(b"k")
+        assert not c.get(b"k").kvs
+
+    def test_txn(self, cluster):
+        _s, _r, c = cluster
+        c.put(b"t", b"1")
+        resp = c.txn(
+            sapi.TxnRequest(
+                compare=[
+                    sapi.Compare(
+                        result=sapi.CompareResult.EQUAL,
+                        target=sapi.CompareTarget.VALUE,
+                        key=b"t",
+                        value=b"1",
+                    )
+                ],
+                success=[
+                    sapi.RequestOp(
+                        request_put=sapi.PutRequest(key=b"t", value=b"2")
+                    )
+                ],
+            )
+        )
+        assert resp.succeeded
+        assert c.get(b"t").kvs[0].value == b"2"
+
+    def test_prefix_get_and_compact(self, cluster):
+        _s, _r, c = cluster
+        for i in range(5):
+            c.put(b"p%d" % i, b"v")
+        rr = c.get(b"p", range_end=b"q")
+        assert rr.count == 5
+        c.compact(rr.header.revision)
+
+    def test_status_and_maintenance(self, cluster):
+        servers, _r, c = cluster
+        st = c.status()
+        assert st["leader"] in (1, 2, 3)
+        h = c.hash_kv()
+        assert "hash" in h
+        members = c.member_list()
+        assert len(members) == 3
+
+
+class TestWatch:
+    def test_watch_live_events(self, cluster):
+        _s, _r, c = cluster
+        h = c.watch(b"w", range_end=b"x")
+        time.sleep(0.1)
+        c.put(b"w1", b"a")
+        c.put(b"w2", b"b")
+        got = []
+        wait_until(
+            lambda: (got.extend(ev for _rev, evs in [h.get(0.2) or (0, [])] for ev in evs), len(got) >= 2)[1],
+            msg="watch events",
+        )
+        assert [ev.kv.key for ev in got[:2]] == [b"w1", b"w2"]
+        h.cancel()
+
+    def test_watch_history_replay(self, cluster):
+        _s, _r, c = cluster
+        r1 = c.put(b"h", b"1").header.revision
+        c.put(b"h", b"2")
+        c.delete(b"h")
+        h = c.watch(b"h", start_rev=r1)
+        events = []
+        deadline = time.monotonic() + 10
+        while len(events) < 3 and time.monotonic() < deadline:
+            batch = h.get(0.2)
+            if batch:
+                events.extend(batch[1])
+        kinds = [ev.type for ev in events[:3]]
+        assert kinds == [EventType.PUT, EventType.PUT, EventType.DELETE]
+        h.cancel()
+
+    def test_watch_survives_endpoint_failover(self, cluster):
+        servers, rpcs, c = cluster
+        h = c.watch(b"f", range_end=b"g")
+        time.sleep(0.1)
+        c.put(b"f1", b"1")
+        batch = h.get(5.0)
+        assert batch is not None
+        # Kill whichever endpoint the client dialed first; it reconnects
+        # and resumes the watch from the last delivered revision.
+        rpcs[1].stop()
+        time.sleep(0.1)
+        c.put(b"f2", b"2")
+        events = []
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            b2 = h.get(0.2)
+            if b2:
+                events.extend(b2[1])
+            if any(ev.kv.key == b"f2" for ev in events):
+                break
+        assert any(ev.kv.key == b"f2" for ev in events)
+
+
+class TestLease:
+    def test_grant_keepalive_session(self, cluster):
+        _s, _r, c = cluster
+        sess = Session(c, ttl=1)
+        c.put(b"sk", b"v", lease=sess.lease_id)
+        time.sleep(2.5)  # keepalive must hold it past its TTL
+        assert c.get(b"sk").kvs
+        sess.close()
+        wait_until(
+            lambda: not c.get(b"sk").kvs, timeout=10.0, msg="revoke on close"
+        )
+
+    def test_lease_expiry_without_keepalive(self, cluster):
+        _s, _r, c = cluster
+        g = c.lease_grant(ttl=1)
+        c.put(b"ek", b"v", lease=g.id)
+        wait_until(
+            lambda: not c.get(b"ek").kvs, timeout=15.0, msg="lease expiry"
+        )
+
+
+class TestConcurrency:
+    def test_mutex_mutual_exclusion(self, cluster):
+        _s, _r, c = cluster
+        c2 = Client(c.endpoints)
+        s1, s2 = Session(c, ttl=5), Session(c2, ttl=5)
+        m1, m2 = Mutex(s1, "/lock/a"), Mutex(s2, "/lock/a")
+        order = []
+        m1.lock()
+        order.append("m1")
+
+        def second():
+            m2.lock()
+            order.append("m2")
+            m2.unlock()
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert order == ["m1"]  # m2 blocked while m1 holds
+        m1.unlock()
+        t.join(timeout=10)
+        assert order == ["m1", "m2"]
+        s1.close()
+        s2.close()
+        c2.close()
+
+    def test_mutex_released_by_session_close(self, cluster):
+        _s, _r, c = cluster
+        c2 = Client(c.endpoints)
+        s1, s2 = Session(c, ttl=1), Session(c2, ttl=5)
+        m1, m2 = Mutex(s1, "/lock/b"), Mutex(s2, "/lock/b")
+        m1.lock()
+        s1.close()  # revokes lease → key deleted → m2 can lock
+        m2.lock(timeout=10)
+        assert m2.is_owner()
+        m2.unlock()
+        s2.close()
+        c2.close()
+
+    def test_election(self, cluster):
+        _s, _r, c = cluster
+        c2 = Client(c.endpoints)
+        s1, s2 = Session(c, ttl=5), Session(c2, ttl=5)
+        e1, e2 = Election(s1, "/el/x"), Election(s2, "/el/x")
+        e1.campaign(b"n1")
+        lead = e1.leader()
+        assert lead.kvs[0].value == b"n1"
+        won = threading.Event()
+
+        def camp2():
+            e2.campaign(b"n2")
+            won.set()
+
+        t = threading.Thread(target=camp2, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert not won.is_set()
+        e1.resign()
+        assert won.wait(timeout=10)
+        assert e1.leader().kvs[0].value == b"n2"
+        s1.close()
+        s2.close()
+        c2.close()
+
+    def test_stm_concurrent_increments(self, cluster):
+        _s, _r, c = cluster
+        c.put(b"ctr", b"0")
+        N, workers = 10, 4
+        clients = [Client(c.endpoints) for _ in range(workers)]
+
+        def bump(cl):
+            stm = STM(cl)
+            for _ in range(N):
+                def tx(t):
+                    cur = t.get(b"ctr")
+                    t.put(b"ctr", str(int(cur or b"0") + 1).encode())
+                stm.run(tx)
+
+        threads = [
+            threading.Thread(target=bump, args=(cl,), daemon=True)
+            for cl in clients
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert int(c.get(b"ctr").kvs[0].value) == N * workers
+        for cl in clients:
+            cl.close()
+
+
+class TestAuthOverClient:
+    def test_auth_roundtrip(self, cluster):
+        _s, _r, c = cluster
+        c.auth_op(sapi.AuthRequest(op="user_add", name="root", password="pw"))
+        c.auth_op(sapi.AuthRequest(op="user_grant_role", name="root", role="root"))
+        c.auth_enable()
+        # Client with credentials can operate.
+        rc = Client(c.endpoints, username="root", password="pw")
+        rc.put(b"a", b"1")
+        assert rc.get(b"a").kvs[0].value == b"1"
+        rc.auth_disable()
+        rc.close()
+
+
+class TestNamespace:
+    def test_prefixed_ops_isolated(self, cluster):
+        from etcd_tpu.client.namespace import NamespacedClient
+
+        _s, _r, c = cluster
+        ns = NamespacedClient(c, b"/app/")
+        ns.put(b"x", b"1")
+        # Raw client sees the prefixed key; namespaced sees stripped.
+        assert c.get(b"/app/x").kvs[0].value == b"1"
+        rr = ns.get(b"x")
+        assert rr.kvs[0].key == b"x"
+        h = ns.watch(b"", range_end=b"\x00")  # whole namespace
+        import time as _t
+
+        _t.sleep(0.1)
+        ns.put(b"y", b"2")
+        batch = h.get(5.0)
+        assert batch is not None
+        assert batch[1][0].kv.key == b"y"
+        h.cancel()
+        ns.delete(b"x")
+        assert not c.get(b"/app/x").kvs
