@@ -453,3 +453,7 @@ class Lessor:
         self._stopped.set()
         with self._expired_cv:
             self._expired_cv.notify_all()
+        # Join so no loop iteration touches the backend after our owner
+        # closes it (daemon threads in C calls at teardown can fault).
+        if self._loop.is_alive():
+            self._loop.join(timeout=5)

@@ -269,4 +269,7 @@ class ExampleRaftNode:
         self._stopped.set()
         self.network.unregister(self.id)
         self.node.stop()
+        for t in (self._ticker, self._server):
+            if t.is_alive() and t is not threading.current_thread():
+                t.join(timeout=5)
         self.wal.close()

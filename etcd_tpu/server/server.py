@@ -870,6 +870,9 @@ class EtcdServer:
         if self.compactor is not None:
             self.compactor.stop()
         self.node.stop()
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=5)
         self.sched.stop()
         self.kv.stop_sync_loop()
         self.lessor.stop()

@@ -254,22 +254,27 @@ class WatchableStore(KVStore):
         (ref: watchable_store.go:211 syncWatchersLoop, every 100ms)."""
         if getattr(self, "_sync_stop", None) is not None:
             return
-        self._sync_stop = threading.Event()
+        stop = threading.Event()
+        self._sync_stop = stop
 
         def loop() -> None:
-            while not self._sync_stop.wait(interval):
+            while not stop.wait(interval):
                 try:
                     self.sync_watchers()
                 except Exception:  # noqa: BLE001 — keep the loop alive
                     pass
 
-        threading.Thread(target=loop, daemon=True).start()
+        self._sync_thread = threading.Thread(target=loop, daemon=True)
+        self._sync_thread.start()
 
     def stop_sync_loop(self) -> None:
         stop = getattr(self, "_sync_stop", None)
         if stop is not None:
             stop.set()
             self._sync_stop = None
+            t = getattr(self, "_sync_thread", None)
+            if t is not None and t.is_alive():
+                t.join(timeout=5)
 
     def _retry_victims(self) -> None:
         still: List[Tuple[Watcher, List[Event]]] = []
