@@ -89,6 +89,7 @@ from .apply import (
 )
 from .cindex import ConsistentIndex
 from .membership import Member, RaftCluster
+from . import metrics as smet
 
 DEFAULT_SNAPSHOT_COUNT = 100000  # ref: server.go:73
 DEFAULT_SNAPSHOT_CATCHUP_ENTRIES = 5000  # ref: server.go:80
@@ -354,6 +355,7 @@ class EtcdServer:
             ci = task.snapshot.metadata.index
         if ci > self._committed_index:
             self._committed_index = ci
+            smet.proposals_committed.set(ci)
 
     def _update_leadership(self, soft_state) -> None:
         """ref: server.go raftReadyHandler updateLeadership."""
@@ -363,6 +365,12 @@ class EtcdServer:
         if prev != soft_state.lead:
             self.leader_changed.set()
             self.leader_changed = threading.Event()
+            if soft_state.lead != NONE:
+                smet.leader_changes.inc()
+        smet.has_leader.set(1 if soft_state.lead != NONE else 0)
+        smet.is_leader.set(
+            1 if soft_state.raft_state == StateType.StateLeader else 0
+        )
         if soft_state.raft_state == StateType.StateLeader:
             if not self.lessor.is_primary():
                 self.lessor.promote(
@@ -434,6 +442,7 @@ class EtcdServer:
                 self._apply_conf_change_entry(e)
             self._applied_index = e.index
             self._term = max(self._term, e.term)
+        smet.proposals_applied.set(self._applied_index)
 
     def _apply_entry_normal(self, e: Entry) -> None:
         """ref: server.go:1811-1913 applyEntryNormal."""
@@ -568,12 +577,16 @@ class EtcdServer:
         )
         data = r.marshal()
         waiter = self.w.register(r.id)
+        smet.proposals_pending.inc()
         try:
             self.node.propose(data, timeout=self.cfg.request_timeout)
             result = waiter.wait(timeout=self.cfg.request_timeout)
         except TimeoutError:
             self.w.trigger(r.id, None)  # deregister
+            smet.proposals_failed.inc()
             raise TimeoutError_()
+        finally:
+            smet.proposals_pending.dec()
         if result is None:
             raise StoppedError()
         if result.err is not None:
@@ -722,8 +735,10 @@ class EtcdServer:
                     return rs.index
             if time.monotonic() >= retry_at:
                 # Leader may have changed or dropped it; re-request.
+                smet.slow_read_indexes.inc()
                 self.node.read_index(rctx)
                 retry_at = time.monotonic() + READ_INDEX_RETRY_TIME
+        smet.read_indexes_failed.inc()
         raise TimeoutError_("read index not confirmed")
 
     # -- auth API (replicated; v3_server.go AuthEnable etc.) -------------------

@@ -33,6 +33,9 @@ from __future__ import annotations
 import os
 import sqlite3
 import threading
+import time
+
+from . import metrics as dmet
 from typing import Callable, Dict, List, Optional, Tuple
 
 DEFAULT_BATCH_INTERVAL = 0.1  # seconds (ref: defaultBatchInterval 100ms)
@@ -237,7 +240,9 @@ class Backend:
     def _commit_locked(self) -> None:
         with self._wlock:
             if self._in_txn:
+                t0 = time.monotonic()
                 self._w.execute("COMMIT")
+                dmet.backend_commit_duration.observe(time.monotonic() - t0)
                 self._in_txn = False
                 self.commits += 1
 

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 from ...pkg.adt import Interval, IntervalTree, point_interval
+from . import metrics as mmet
 from .kv import Event, EventType, KeyValue
 from .kvstore import KVStore
 from .revision import rev_to_bytes
@@ -180,17 +181,23 @@ class WatchableStore(KVStore):
                 self.synced.add(w)
             else:
                 self.unsynced.add(w)
+            mmet.watcher_total.inc()
+            self._update_slow_gauge()
             return w
 
     def cancel_watcher(self, w: Watcher) -> bool:
         with self._wlock:
-            if self.synced.remove(w) or self.unsynced.remove(w):
-                return True
-            for i, (vw, _) in enumerate(self._victims):
-                if vw is w:
-                    del self._victims[i]
-                    return True
-            return False
+            found = self.synced.remove(w) or self.unsynced.remove(w)
+            if not found:
+                for i, (vw, _) in enumerate(self._victims):
+                    if vw is w:
+                        del self._victims[i]
+                        found = True
+                        break
+            if found:
+                mmet.watcher_total.dec()
+            self._update_slow_gauge()
+            return found
 
     # -- fanout ----------------------------------------------------------------
 
@@ -204,12 +211,15 @@ class WatchableStore(KVStore):
                     per_w.setdefault(w, []).append(ev)
             for w, evs in per_w.items():
                 ok = w.send(WatchResponse(w.id, evs, rev))
+                if ok:
+                    mmet.events_total.inc(len(evs))
                 if not ok:
                     # victim: move out of synced, retry async
                     self.synced.remove(w)
                     w.victim = True
                     w.min_rev = rev + 1
                     self._victims.append((w, evs))
+            self._update_slow_gauge()
 
     def sync_watchers(self, max_watchers: int = 512) -> int:
         """One pass of the unsynced catch-up loop; returns watchers
@@ -232,6 +242,7 @@ class WatchableStore(KVStore):
                     w.send(WatchResponse(w.id, [], cur,
                                          compact_revision=compact))
                     self.unsynced.remove(w)
+                    mmet.watcher_total.dec()  # cancelled at compaction
                     continue
                 mine = [
                     e for e in evs
@@ -244,9 +255,12 @@ class WatchableStore(KVStore):
                     self.unsynced.remove(w)
                     self._victims.append((w, mine))
                     continue
+                if mine:
+                    mmet.events_total.inc(len(mine))
                 w.min_rev = cur + 1
                 self.unsynced.remove(w)
                 self.synced.add(w)
+            self._update_slow_gauge()
             return len(self.unsynced)
 
     def start_sync_loop(self, interval: float = 0.1) -> None:
@@ -282,6 +296,7 @@ class WatchableStore(KVStore):
             if w.send(WatchResponse(w.id, evs,
                                     evs[-1].kv.mod_revision if evs else
                                     self.rev())):
+                mmet.events_total.inc(len(evs))
                 w.victim = False
                 # Writes may have happened while victimized; if so the
                 # watcher needs history replay before going live again
@@ -293,6 +308,9 @@ class WatchableStore(KVStore):
             else:
                 still.append((w, evs))
         self._victims = still
+
+    def _update_slow_gauge(self) -> None:
+        mmet.slow_watcher_total.set(len(self.unsynced) + len(self._victims))
 
     @staticmethod
     def _match(w: Watcher, ev: Event) -> bool:

@@ -26,11 +26,13 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 from ..native import walog as nwalog
 from ..raft.types import Entry, EntryType, HardState, is_empty_hard_state
+from . import metrics as dmet
 
 # Record types (native type 0 is reserved for the CRC chain seed).
 REC_METADATA = 1
@@ -176,7 +178,12 @@ class WAL:
         if not is_empty_hard_state(hs):
             self._w.append(REC_STATE, _STATE.pack(hs.term, hs.vote, hs.commit))
         sync = must_sync if must_sync is not None else True
-        self._w.flush(sync=sync)
+        if sync:
+            t0 = time.monotonic()
+            self._w.flush(sync=True)
+            dmet.wal_fsync_duration.observe(time.monotonic() - t0)
+        else:
+            self._w.flush(sync=False)
         if self._w.tail_offset() > self._segment_bytes:
             self._cut()
 

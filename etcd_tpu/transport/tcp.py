@@ -32,6 +32,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..raft.types import Message, MessageType
+from . import metrics as smet
 from .codec import MAX_FRAME, decode_message, encode_message
 
 STREAM_BUF = 4096  # queued msgs per peer (streamBufSize stream.go:32)
@@ -93,6 +94,7 @@ class _Peer:
             if m is None or self._stopped.is_set():
                 break
             frame = encode_message(m)
+            sent = False
             for _attempt in (0, 1):
                 if sock is None:
                     sock = self._dial()
@@ -101,6 +103,8 @@ class _Peer:
                         break  # drop m
                 try:
                     sock.sendall(frame)
+                    smet.peer_sent_bytes.labels(str(self.id)).inc(len(frame))
+                    sent = True
                     break
                 except OSError:
                     try:
@@ -109,6 +113,8 @@ class _Peer:
                         pass
                     sock = None
                     self.active_since = 0.0
+            if not sent:
+                smet.peer_sent_failures.labels(str(self.id)).inc()
         if sock is not None:
             try:
                 sock.close()
@@ -280,6 +286,7 @@ class TCPTransport:
                 payload = self._read_exact(conn, ln)
                 if payload is None:
                     return
+                smet.peer_received_bytes.labels(str(from_id)).inc(4 + ln)
                 with self._lock:
                     drop = self._drop.get(from_id, 0.0)
                 if drop and self._rand.random() < drop:

@@ -1,0 +1,117 @@
+"""Metrics registry + /health /metrics /version endpoints
+(ref: etcdhttp/metrics.go tests, etcdhttp/base.go)."""
+
+import json
+import urllib.request
+
+from etcd_tpu.etcdhttp import EtcdHTTP
+from etcd_tpu.pkg import metrics as pmet
+from etcd_tpu.raftexample.transport import InProcNetwork
+from etcd_tpu.server import EtcdServer, ServerConfig
+
+from .test_etcdserver import wait_until
+
+
+def _get(addr, path):
+    url = f"http://{addr[0]}:{addr[1]}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_exposition(self):
+        reg = pmet.Registry()
+        c = reg.register(pmet.Counter("x_total", "a counter"))
+        g = reg.register(pmet.Gauge("x_gauge", "a gauge"))
+        h = reg.register(pmet.Histogram("x_seconds", "a hist", buckets=(0.1, 1)))
+        c.inc()
+        c.inc(2)
+        g.set(7)
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(10)
+        text = reg.expose()
+        assert "# TYPE x_total counter" in text
+        assert "x_total 3" in text
+        assert "x_gauge 7" in text
+        assert 'x_seconds_bucket{le="0.1"} 1' in text
+        assert 'x_seconds_bucket{le="1"} 2' in text
+        assert 'x_seconds_bucket{le="+Inf"} 3' in text
+        assert "x_seconds_count 3" in text
+
+    def test_labels(self):
+        reg = pmet.Registry()
+        c = reg.register(pmet.Counter("y_total", "labeled", ("To",)))
+        c.labels("1").inc(5)
+        c.labels("2").inc(1)
+        text = reg.expose()
+        assert 'y_total{To="1"} 5' in text
+        assert 'y_total{To="2"} 1' in text
+
+    def test_registry_dedup(self):
+        reg = pmet.Registry()
+        a = reg.register(pmet.Counter("z_total", "z"))
+        b = reg.register(pmet.Counter("z_total", "z"))
+        assert a is b
+
+
+class TestEtcdHTTP:
+    def test_endpoints_against_live_server(self, tmp_path):
+        net = InProcNetwork()
+        srv = EtcdServer(
+            ServerConfig(
+                member_id=1, peers=[1], data_dir=str(tmp_path),
+                network=net, tick_interval=0.01,
+            )
+        )
+        http = EtcdHTTP(server=srv)
+        try:
+            wait_until(lambda: srv.is_leader(), msg="leader")
+            code, body = _get(http.addr, "/version")
+            assert code == 200
+            v = json.loads(body)
+            assert "etcdserver" in v and "etcdcluster" in v
+
+            code, body = _get(http.addr, "/health")
+            assert code == 200
+            assert json.loads(body)["health"] == "true"
+
+            code, body = _get(http.addr, "/metrics")
+            assert code == 200
+            assert "etcd_server_has_leader 1" in body
+            assert "etcd_server_is_leader 1" in body
+            assert "etcd_disk_wal_fsync_duration_seconds_bucket" in body
+
+            code, body = _get(http.addr, "/readyz?verbose")
+            assert code == 200
+            assert "ok" in body
+
+            code, _ = _get(http.addr, "/nope")
+            assert code == 404
+        finally:
+            http.close()
+            srv.stop()
+
+    def test_health_serializable_without_leader(self, tmp_path):
+        # A single standalone server that never elects (no peers started)
+        # still answers serializable health probes.
+        net = InProcNetwork()
+        srv = EtcdServer(
+            ServerConfig(
+                member_id=1, peers=[1, 2, 3], data_dir=str(tmp_path),
+                network=net, tick_interval=0.01, request_timeout=1.0,
+            )
+        )
+        http = EtcdHTTP(server=srv)
+        try:
+            code, body = _get(http.addr, "/health?serializable=true")
+            assert code == 200
+            code, body = _get(http.addr, "/health")
+            assert code == 503
+            assert json.loads(body)["health"] == "false"
+        finally:
+            http.close()
+            srv.stop()
