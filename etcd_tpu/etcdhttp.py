@@ -66,8 +66,9 @@ class EtcdHTTP:
 
     def _route(self, h: BaseHTTPRequestHandler) -> None:
         u = urlparse(h.path)
-        q = parse_qs(u.query)
+        q = parse_qs(u.query, keep_blank_values=True)
         if u.path == "/metrics":
+            self._refresh_gauges()
             body = self.registry.expose().encode()
             self._reply(h, 200, body, "text/plain; version=0.0.4")
         elif u.path == "/version":
@@ -96,6 +97,25 @@ class EtcdHTTP:
             h.end_headers()
             h.wfile.write(body)
         except OSError:
+            pass
+
+    def _refresh_gauges(self) -> None:
+        """Point-in-time store gauges, refreshed per scrape (the
+        reference refreshes them on backend commit hooks)."""
+        s = self.server
+        if s is None:
+            return
+        from .storage.mvcc import metrics as mmet
+
+        try:
+            mmet.db_total_size.set(s.be.size())
+            mmet.db_in_use_size.set(s.be.size_in_use())
+            mmet.current_revision.set(s.kv.rev())
+            mmet.compact_revision.set(s.kv.compact_rev)
+            mmet.keys_total.set(
+                s.kv.index.count_revisions(b"", b"\xff" * 32, s.kv.rev())
+            )
+        except Exception:  # noqa: BLE001 — scrape must not 500
             pass
 
     # -- health (etcdhttp/metrics.go checkHealth) ------------------------------

@@ -60,10 +60,13 @@ class _Metric:
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = type(self)(self.name, self.help)
+                child = self._new_child()
                 child._labelvalues = key  # type: ignore[attr-defined]
                 self._children[key] = child
             return child
+
+    def _new_child(self) -> "_Metric":
+        return type(self)(self.name, self.help)
 
     def _samples(self) -> Iterable[Tuple[str, Sequence[str], Sequence[str], float]]:
         raise NotImplementedError
@@ -158,12 +161,8 @@ class Histogram(_Metric):
         self._sum = 0.0
         self._count = 0
 
-    def labels(self, *values: str, **kv: str):
-        child = super().labels(*values, **kv)
-        child.buckets = self.buckets  # type: ignore[attr-defined]
-        if len(child._counts) != len(self.buckets) + 1:  # fresh child
-            child._counts = [0] * (len(self.buckets) + 1)
-        return child
+    def _new_child(self) -> "_Metric":
+        return Histogram(self.name, self.help, buckets=self.buckets)
 
     def observe(self, v: float) -> None:
         with self._lock:

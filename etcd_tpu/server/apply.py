@@ -16,6 +16,7 @@ from typing import Any, List, Optional, Tuple
 from ..auth.store import AuthInfo, PermissionType, Permission
 from ..lease.lessor import LeaseItem, LeaseNotFoundError, NoLease
 from ..storage.mvcc.kv import KeyValue, RangeOptions
+from ..storage.mvcc import metrics as mmet
 from .api import (
     AlarmAction,
     AlarmMember,
@@ -117,6 +118,7 @@ class ApplierBackend:
 
     def put(self, p: PutRequest, txn=None) -> PutResponse:
         """ref: apply.go:251-332 Put."""
+        mmet.put_total.inc()
         resp = PutResponse(header=self._header())
         owned = txn is None
         if owned:
@@ -151,6 +153,7 @@ class ApplierBackend:
         self, dr: DeleteRangeRequest, txn=None
     ) -> DeleteRangeResponse:
         """ref: apply.go DeleteRange."""
+        mmet.delete_total.inc()
         resp = DeleteRangeResponse(header=self._header())
         owned = txn is None
         if owned:
@@ -170,6 +173,7 @@ class ApplierBackend:
 
     def range(self, rreq: RangeRequest, txn=None) -> RangeResponse:
         """ref: apply.go:334-439 Range."""
+        mmet.range_total.inc()
         resp = RangeResponse(header=self._header())
         end = rreq.range_end if rreq.range_end else None
 
@@ -242,6 +246,7 @@ class ApplierBackend:
     # -- txn (apply.go:441-680) ------------------------------------------------
 
     def txn(self, tr: TxnRequest) -> TxnResponse:
+        mmet.txn_total.inc()
         is_write = _is_txn_write(tr)
         if is_write:
             txn = self.s.kv.write()

@@ -289,7 +289,16 @@ class EtcdServer:
     # -- loops -----------------------------------------------------------------
 
     def _tick_loop(self) -> None:
+        # Slow-tick detector: a delayed heartbeat tick usually means the
+        # loop thread was starved (slow disk / GC) — the reference's
+        # heartbeat contention detector (etcdserver/raft.go:132-134).
+        from ..pkg.contention import TimeoutDetector
+
+        td = TimeoutDetector(2 * self.cfg.tick_interval)
         while not self._stopped.wait(self.cfg.tick_interval):
+            ok, _ = td.observe(0)
+            if not ok and self.is_leader():
+                smet.heartbeat_send_failures.inc()
             self.node.tick()
 
     def _receive_message(self, m: Message) -> None:
@@ -388,8 +397,13 @@ class EtcdServer:
 
     def _apply_all(self, task: _ApplyTask) -> None:
         """ref: server.go:903 applyAll."""
+        t0 = time.monotonic()
         self._apply_snapshot(task)
         self._apply_entries(task)
+        dt = time.monotonic() - t0
+        smet.apply_duration.observe(dt)
+        if dt > 0.1:  # warnApplyDuration (server.go:83)
+            smet.slow_applies.inc()
         self.apply_wait.trigger(self._applied_index)
         self._maybe_trigger_snapshot()
 
@@ -404,6 +418,7 @@ class EtcdServer:
                 f"snapshot index [{snap.metadata.index}] should > "
                 f"applied index [{self._applied_index}]"
             )
+        smet.snapshot_apply_in_progress.set(1)
         task.persisted.wait()  # snapshot durable before opening it
         payload = json.loads(snap.data.decode())
         db_bytes = bytes.fromhex(payload["db"])
@@ -424,6 +439,7 @@ class EtcdServer:
         self._applied_index = snap.metadata.index
         self._term = max(self._term, snap.metadata.term)
         self.cindex.set_consistent_index(self._applied_index, self._term)
+        smet.snapshot_apply_in_progress.set(0)
 
     def _apply_entries(self, task: _ApplyTask) -> None:
         if not task.entries:
@@ -634,18 +650,24 @@ class EtcdServer:
         if lease_id == 0:
             lease_id = self.idgen.next() & 0x7FFFFFFFFFFFFFFF
         req = LeaseGrantRequest(ttl=ttl, id=lease_id)
-        return self.process_internal_raft_request("lease_grant", req, token).resp
+        resp = self.process_internal_raft_request("lease_grant", req, token).resp
+        smet.lease_granted.inc()
+        return resp
 
     def lease_revoke(self, lease_id: int, token: Optional[str] = None):
         req = LeaseRevokeRequest(id=lease_id)
-        return self.process_internal_raft_request("lease_revoke", req, token).resp
+        resp = self.process_internal_raft_request("lease_revoke", req, token).resp
+        smet.lease_revoked.inc()
+        return resp
 
     def lease_renew(self, lease_id: int) -> int:
         """Keepalive: primary lessor only; followers raise NotLeader and
         the client retries against the leader (v3_server.go LeaseRenew)."""
         if not self.lessor.is_primary():
             raise NotLeaderError()
-        return self.lessor.renew(lease_id)
+        ttl = self.lessor.renew(lease_id)
+        smet.lease_renewed.inc()
+        return ttl
 
     def lease_time_to_live(self, lease_id: int, keys: bool = False):
         lease = self.lessor.lookup(lease_id)
@@ -841,6 +863,8 @@ class EtcdServer:
         )
         result = self._propose_conf_change(cc, timeout)
         self.cluster.promote_member(mid)
+        if self.is_leader():
+            smet.learner_promote_succeed.inc()
         return result
 
     def _propose_conf_change(self, cc: ConfChange, timeout: Optional[float]):

@@ -229,6 +229,7 @@ class WatchableStore(KVStore):
                 return 0
             self._retry_victims()
             if len(self.unsynced) == 0:
+                self._update_slow_gauge()
                 return len(self.unsynced)
             cur = self.rev()
             compact = self.compact_rev
@@ -311,6 +312,9 @@ class WatchableStore(KVStore):
 
     def _update_slow_gauge(self) -> None:
         mmet.slow_watcher_total.set(len(self.unsynced) + len(self._victims))
+        mmet.pending_events_total.set(
+            sum(len(evs) for _, evs in self._victims)
+        )
 
     @staticmethod
     def _match(w: Watcher, ev: Event) -> bool:
@@ -348,6 +352,7 @@ class WatchStream:
         self._q: Deque[WatchResponse] = deque()
         self._watchers: Dict[int, Watcher] = {}
         self._closed = False
+        mmet.watch_stream_total.inc()
 
     # watchers call this; False → would exceed cap (victim path)
     def _deliver(self, resp: WatchResponse) -> bool:
@@ -399,8 +404,11 @@ class WatchStream:
 
     def close(self) -> None:
         with self._lock:
+            if self._closed:
+                return
             wids = list(self._watchers)
             self._closed = True
             self._cond.notify_all()
+        mmet.watch_stream_total.dec()
         for wid in wids:
             self.cancel(wid)

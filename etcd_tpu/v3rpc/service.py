@@ -16,6 +16,7 @@ import threading
 from typing import Any, Dict, Optional
 
 from ..server import api as sapi
+from ..server import metrics as smet
 from ..server.membership import Member
 from . import wire
 
@@ -75,7 +76,8 @@ class _Conn:
     def _send(self, obj: Dict[str, Any]) -> bool:
         try:
             with self.wlock:
-                wire.write_frame(self.sock, obj)
+                n = wire.write_frame(self.sock, obj)
+            smet.client_grpc_sent_bytes.inc(n)
             return True
         except OSError:
             return False
@@ -83,7 +85,9 @@ class _Conn:
     def _read_loop(self) -> None:
         try:
             while not self.srv._stopped.is_set():
-                req = wire.read_frame(self.sock)
+                req = wire.read_frame(
+                    self.sock, counter=smet.client_grpc_received_bytes.inc
+                )
                 if req is None:
                     return
                 threading.Thread(

@@ -20,12 +20,15 @@ from ..storage.mvcc.kv import Event, EventType, KeyValue
 MAX_FRAME = 512 << 20
 
 
-def write_frame(sock: socket.socket, obj: Dict[str, Any]) -> None:
+def write_frame(sock: socket.socket, obj: Dict[str, Any]) -> int:
     body = json.dumps(obj, separators=(",", ":")).encode()
     sock.sendall(struct.pack("<I", len(body)) + body)
+    return 4 + len(body)
 
 
-def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
+def read_frame(sock: socket.socket, counter=None) -> Optional[Dict[str, Any]]:
+    """`counter`, if given, is called with the frame size in bytes
+    (server-side traffic metrics)."""
     hdr = _read_exact(sock, 4)
     if hdr is None:
         return None
@@ -35,6 +38,8 @@ def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
     body = _read_exact(sock, ln)
     if body is None:
         return None
+    if counter is not None:
+        counter(4 + ln)
     return json.loads(body.decode())
 
 

@@ -87,7 +87,12 @@ class TestEtcdHTTP:
 
             code, body = _get(http.addr, "/readyz?verbose")
             assert code == 200
-            assert "ok" in body
+            assert "[+]serializable_read ok" in body
+            assert "[+]leader ok" in body
+
+            code, body = _get(http.addr, "/metrics")
+            assert "etcd_mvcc_db_total_size_in_bytes" in body
+            assert "etcd_debugging_mvcc_current_revision" in body
 
             code, _ = _get(http.addr, "/nope")
             assert code == 404
