@@ -1,0 +1,8 @@
+"""`python -m etcd_tpu` → etcdmain (ref: server/main.go)."""
+
+import sys
+
+from .etcdmain import main
+
+if __name__ == "__main__":
+    sys.exit(main())

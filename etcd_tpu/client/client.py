@@ -233,7 +233,10 @@ class Client:
         params: Dict[str, Any],
         timeout: Optional[float] = None,
         _no_reauth: bool = False,
+        token: Optional[str] = None,
     ) -> Any:
+        """`token` overrides the client's own auth token for this call
+        (used by grpcproxy to forward the downstream caller's token)."""
         timeout = timeout or self.request_timeout
         attempts = max(2 * len(self.endpoints), 2)
         last: Optional[ClientError] = None
@@ -241,7 +244,7 @@ class Client:
             if self._closed:
                 raise ClientError("Closed", "client closed")
             try:
-                return self._request_once(method, params, timeout)
+                return self._request_once(method, params, timeout, token=token)
             except ClientError as e:
                 last = e
                 if e.etype == "InvalidAuthTokenError" and not _no_reauth and self.username:
@@ -274,7 +277,8 @@ class Client:
                 pass
         self._connect_any()
 
-    def _request_once(self, method: str, params: Dict, timeout: float) -> Any:
+    def _request_once(self, method: str, params: Dict, timeout: float,
+                      token: Optional[str] = None) -> Any:
         with self._lock:
             sock = self._sock
             rid = self._next_id
@@ -288,8 +292,9 @@ class Client:
             err.sent = False
             raise err
         msg = {"id": rid, "method": method, "params": params}
-        if self.token is not None:
-            msg["token"] = self.token
+        tok = token if token is not None else self.token
+        if tok is not None:
+            msg["token"] = tok
         try:
             with self._wlock:
                 wire.write_frame(sock, msg)

@@ -112,9 +112,6 @@ class EtcdHTTP:
             mmet.db_in_use_size.set(s.be.size_in_use())
             mmet.current_revision.set(s.kv.rev())
             mmet.compact_revision.set(s.kv.compact_rev)
-            mmet.keys_total.set(
-                s.kv.index.count_revisions(b"", b"\xff" * 32, s.kv.rev())
-            )
         except Exception:  # noqa: BLE001 — scrape must not 500
             pass
 

@@ -1,0 +1,189 @@
+"""CLI entry (ref: server/etcdmain/main.go:25 Main, etcd.go:53
+startEtcdOrProxyV2, gateway.go, config.go flag set).
+
+Subcommands:
+
+* (default) / ``etcd``   — start a member from flags or --config-file
+* ``gateway start``      — the L4 TCP forwarder (etcdmain/gateway.go)
+* ``grpc-proxy start``   — the caching/coalescing L7 proxy
+
+``python -m etcd_tpu`` lands here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from .. import version as ver
+from ..embed import Config, config_from_file, start_etcd
+from ..embed.config import ConfigError, parse_urls
+
+
+def _add_member_flags(p: argparse.ArgumentParser) -> None:
+    cfg = Config()
+    p.add_argument("--name", default=cfg.name)
+    p.add_argument("--data-dir", default="")
+    p.add_argument("--listen-peer-urls", default=cfg.listen_peer_urls)
+    p.add_argument("--listen-client-urls", default=cfg.listen_client_urls)
+    p.add_argument("--listen-metrics-urls", default="")
+    p.add_argument("--initial-advertise-peer-urls", default="")
+    p.add_argument("--advertise-client-urls", default="")
+    p.add_argument("--initial-cluster", default="")
+    p.add_argument("--initial-cluster-state", default=cfg.initial_cluster_state)
+    p.add_argument("--initial-cluster-token", default=cfg.initial_cluster_token)
+    p.add_argument("--heartbeat-interval", type=int, default=cfg.heartbeat_interval)
+    p.add_argument("--election-timeout", type=int, default=cfg.election_timeout)
+    p.add_argument("--snapshot-count", type=int, default=cfg.snapshot_count)
+    p.add_argument("--quota-backend-bytes", type=int, default=cfg.quota_backend_bytes)
+    p.add_argument("--max-request-bytes", type=int, default=cfg.max_request_bytes)
+    p.add_argument("--auto-compaction-mode", default="")
+    p.add_argument("--auto-compaction-retention", default="0")
+    p.add_argument("--auth-token", default=cfg.auth_token)
+    p.add_argument("--log-level", default=cfg.log_level)
+    p.add_argument("--enable-pprof", action="store_true")
+    p.add_argument("--config-file", default="")
+
+
+def _config_from_args(args: argparse.Namespace) -> Config:
+    if args.config_file:
+        return config_from_file(args.config_file)
+    cfg = Config()
+    for f in cfg.__dataclass_fields__:
+        if hasattr(args, f):
+            setattr(cfg, f, getattr(args, f))
+    if not cfg.initial_cluster:
+        cfg.initial_cluster = (
+            f"{cfg.name}={cfg.effective_advertise_peer_urls()}"
+        )
+    return cfg
+
+
+def _run_etcd(args: argparse.Namespace) -> int:
+    try:
+        cfg = _config_from_args(args)
+        e = start_etcd(cfg)
+    except ConfigError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    ch, cp = e.client_addr
+    mh, mp = e.metrics_addr
+    print(
+        f"etcd_tpu member {cfg.name} ({e.server.id:x}) serving: "
+        f"clients http://{ch}:{cp}, metrics http://{mh}:{mp}",
+        flush=True,
+    )
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    try:
+        while not stop.is_set() and not e.server._stopped.is_set():
+            stop.wait(0.2)
+    finally:
+        e.close()
+    return 0
+
+
+def _run_gateway(args: argparse.Namespace) -> int:
+    from ..proxy.tcpproxy import TCPProxy
+
+    eps = parse_urls(
+        ",".join(
+            x if "://" in x else f"http://{x}"
+            for x in args.endpoints.split(",")
+        )
+    )
+    host, port = args.listen_addr.rsplit(":", 1)
+    proxy = TCPProxy(eps, bind=(host, int(port)),
+                     monitor_interval=args.retry_delay)
+    print(
+        f"tcpproxy: ready to proxy client requests to {eps}", flush=True
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(0.2)
+    finally:
+        proxy.stop()
+    return 0
+
+
+def _run_grpc_proxy(args: argparse.Namespace) -> int:
+    from ..proxy.grpcproxy import start_grpc_proxy
+
+    eps = []
+    for x in args.endpoints.split(","):
+        if "://" not in x:
+            x = f"http://{x}"
+        eps.extend(parse_urls(x))
+    host, port = args.listen_addr.rsplit(":", 1)
+    proxy = start_grpc_proxy(eps, bind=(host, int(port)))
+    print(f"grpcproxy: listening on {proxy.addr}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(0.2)
+    finally:
+        proxy.stop()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(
+        prog="etcd_tpu", description="etcd-capability TPU-native framework"
+    )
+    parser.add_argument("--version", action="store_true")
+    sub = parser.add_subparsers(dest="cmd")
+
+    p_etcd = sub.add_parser("etcd", help="start a member")
+    _add_member_flags(p_etcd)
+
+    p_gw = sub.add_parser("gateway", help="L4 gateway")
+    gw_sub = p_gw.add_subparsers(dest="gw_cmd")
+    p_gw_start = gw_sub.add_parser("start")
+    p_gw_start.add_argument("--listen-addr", default="127.0.0.1:23790")
+    p_gw_start.add_argument("--endpoints", default="127.0.0.1:2379")
+    p_gw_start.add_argument("--retry-delay", type=float, default=60.0)
+
+    p_gp = sub.add_parser("grpc-proxy", help="L7 caching/coalescing proxy")
+    gp_sub = p_gp.add_subparsers(dest="gp_cmd")
+    p_gp_start = gp_sub.add_parser("start")
+    p_gp_start.add_argument("--listen-addr", default="127.0.0.1:23790")
+    p_gp_start.add_argument("--endpoints", default="127.0.0.1:2379")
+
+    # Bare flags (no subcommand) start a member, like `etcd --...`.
+    if not argv or argv[0].startswith("-"):
+        if "--version" in argv:
+            print(f"etcd_tpu Version: {ver.SERVER_VERSION}")
+            print(f"Cluster Version: {ver.CLUSTER_VERSION}")
+            print(f"API Version: {ver.API_VERSION}")
+            return 0
+        argv = ["etcd"] + argv
+
+    args = parser.parse_args(argv)
+    if args.cmd == "etcd":
+        return _run_etcd(args)
+    if args.cmd == "gateway":
+        if getattr(args, "gw_cmd", None) != "start":
+            p_gw.print_help()
+            return 2
+        return _run_gateway(args)
+    if args.cmd == "grpc-proxy":
+        if getattr(args, "gp_cmd", None) != "start":
+            p_gp.print_help()
+            return 2
+        return _run_grpc_proxy(args)
+    parser.print_help()
+    return 2

@@ -1,0 +1,2 @@
+"""Proxies (ref: server/proxy/): tcpproxy (the `etcd gateway` L4
+forwarder) and grpcproxy (the caching/coalescing L7 proxy)."""

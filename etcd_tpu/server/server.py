@@ -118,6 +118,10 @@ class MemberRemovedError(Exception):
     pass
 
 
+class RequestTooLargeError(Exception):
+    """ref: rpctypes.ErrRequestTooLarge (v3_server.go size check)."""
+
+
 @dataclass
 class ServerConfig:
     member_id: int = 1
@@ -138,6 +142,8 @@ class ServerConfig:
     lease_checkpoint_interval: float = 300.0
     pre_vote: bool = True
     request_timeout: float = 7.0
+    max_request_bytes: int = 1536 * 1024  # ref: embed/config.go DefaultMaxRequestBytes
+    auth_token: str = "simple"  # "simple" | "hmac:<key>" (ref: --auth-token)
 
 
 @dataclass
@@ -228,7 +234,14 @@ class EtcdServer:
         )
         self.kv = WatchableStore(self.be, self.lessor)
         self.kv.start_sync_loop()
-        self.auth_store = AuthStore(self.be, token_provider=SimpleTokenProvider())
+        spec = self.cfg.auth_token
+        if spec.startswith("hmac:"):
+            from ..auth.hmac_token import HMACTokenProvider
+
+            provider = HMACTokenProvider(spec[len("hmac:"):].encode())
+        else:
+            provider = SimpleTokenProvider()
+        self.auth_store = AuthStore(self.be, token_provider=provider)
         self.alarms = AlarmStore(self.be)
         self.cluster = RaftCluster(self.cluster_id, self.be)
 
@@ -419,27 +432,31 @@ class EtcdServer:
                 f"applied index [{self._applied_index}]"
             )
         smet.snapshot_apply_in_progress.set(1)
-        task.persisted.wait()  # snapshot durable before opening it
-        payload = json.loads(snap.data.decode())
-        db_bytes = bytes.fromhex(payload["db"])
-        newdb = os.path.join(self.member_dir, f"db.snap.{snap.metadata.index}")
-        with open(newdb, "wb") as f:
-            f.write(db_bytes)
-            f.flush()
-            os.fsync(f.fileno())
-        # Tear down stores over the old backend, swap the file, reopen.
-        self.kv.stop_sync_loop()
-        self.lessor.stop()
-        self.be.close()
-        os.replace(newdb, self.db_path)
-        self._open_backend_stack()
-        self.lessor.checkpointer = self._lease_checkpoint_via_raft
-        self.lessor.range_deleter = lambda: _LeaseDeleterTxn(self)
-        self.confstate = snap.metadata.conf_state
-        self._applied_index = snap.metadata.index
-        self._term = max(self._term, snap.metadata.term)
-        self.cindex.set_consistent_index(self._applied_index, self._term)
-        smet.snapshot_apply_in_progress.set(0)
+        try:
+            task.persisted.wait()  # snapshot durable before opening it
+            payload = json.loads(snap.data.decode())
+            db_bytes = bytes.fromhex(payload["db"])
+            newdb = os.path.join(
+                self.member_dir, f"db.snap.{snap.metadata.index}"
+            )
+            with open(newdb, "wb") as f:
+                f.write(db_bytes)
+                f.flush()
+                os.fsync(f.fileno())
+            # Tear down stores over the old backend, swap the file, reopen.
+            self.kv.stop_sync_loop()
+            self.lessor.stop()
+            self.be.close()
+            os.replace(newdb, self.db_path)
+            self._open_backend_stack()
+            self.lessor.checkpointer = self._lease_checkpoint_via_raft
+            self.lessor.range_deleter = lambda: _LeaseDeleterTxn(self)
+            self.confstate = snap.metadata.conf_state
+            self._applied_index = snap.metadata.index
+            self._term = max(self._term, snap.metadata.term)
+            self.cindex.set_consistent_index(self._applied_index, self._term)
+        finally:
+            smet.snapshot_apply_in_progress.set(0)
 
     def _apply_entries(self, task: _ApplyTask) -> None:
         if not task.entries:
@@ -592,6 +609,8 @@ class EtcdServer:
             auth_revision=info.revision if info else 0,
         )
         data = r.marshal()
+        if len(data) > self.cfg.max_request_bytes:
+            raise RequestTooLargeError()
         waiter = self.w.register(r.id)
         smet.proposals_pending.inc()
         try:

@@ -84,6 +84,19 @@ class TreeIndex:
                         at_rev: int) -> int:
         return self.revisions(start, end, at_rev)[1]
 
+    def count_all(self, at_rev: int) -> int:
+        """Live keys at at_rev over the WHOLE key space (no end bound —
+        arbitrary bytes are legal keys)."""
+        with self._lock:
+            total = 0
+            for ki in self._tree.values():
+                try:
+                    ki.get(at_rev)
+                    total += 1
+                except RevisionNotFound:
+                    continue
+            return total
+
     def range_since(self, start: bytes, end: Optional[bytes],
                     rev: int) -> List[Revision]:
         """All revisions ≥ rev touching keys in the range, ascending by

@@ -27,6 +27,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import backend as bk
+from . import metrics as mmet
 from .index import TreeIndex
 from .key_index import RevisionNotFound
 from .kv import Event, EventType, KeyValue, RangeOptions, RangeResult
@@ -87,6 +88,7 @@ class KVStore:
             srev = struct.unpack("<q", sched)[0]
             if srev > self.compact_rev:
                 self.compact(srev)  # resume interrupted compaction
+        mmet.keys_total.set(self.index.count_all(self.current_rev))
 
     # -- read path ------------------------------------------------------------
 
@@ -222,6 +224,7 @@ class WriteTxn:
         self._on_end = on_end
         self._saved_ki: Dict[bytes, object] = {}  # key -> KeyIndex copy|None
         self._written_rows: List[bytes] = []
+        self._keys_delta = 0  # live-key gauge delta, applied on commit
 
     def __enter__(self) -> "WriteTxn":
         self.s._lock.acquire()
@@ -235,6 +238,10 @@ class WriteTxn:
             if committed:
                 self.s.current_rev += 1
                 self.rev = self.s.current_rev
+                if self._keys_delta > 0:
+                    mmet.keys_total.inc(self._keys_delta)
+                elif self._keys_delta < 0:
+                    mmet.keys_total.dec(-self._keys_delta)
                 # Notify while both locks are held so watchers observe
                 # revisions in commit order (the reference notifies in
                 # txn End under the store mutex).
@@ -283,6 +290,8 @@ class WriteTxn:
         self.s.b.batch_tx.put(bk.KEY, rkey, kv.marshal())
         self._written_rows.append(rkey)
         self.s.index.put(key, rev)
+        if version == 1:
+            self._keys_delta += 1  # new live key (ref: kvstore_txn.go put)
         self.changes.append(Event(type=EventType.PUT, kv=kv))
         les = self.s.lessor
         if les is not None:
@@ -310,6 +319,7 @@ class WriteTxn:
             self.s.b.batch_tx.put(bk.KEY, rkey, prev_kv.key)
             self._written_rows.append(rkey)
             self.s.index.tombstone(prev_kv.key, rev)
+            self._keys_delta -= 1
             self.changes.append(Event(
                 type=EventType.DELETE,
                 kv=KeyValue(key=prev_kv.key, mod_revision=rev.main),

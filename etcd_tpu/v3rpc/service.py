@@ -30,7 +30,7 @@ class V3RPCServer:
         self._listener.bind(bind)
         self._listener.listen(128)
         self.addr = self._listener.getsockname()
-        self._conns: list = []
+        self._conns: set = set()
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
     def stop(self) -> None:
@@ -60,7 +60,7 @@ class V3RPCServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.append(conn)
+            self._conns.add(conn)
             _Conn(self, conn)
 
 
@@ -96,6 +96,7 @@ class _Conn:
         finally:
             if self.watch_stream is not None:
                 self.watch_stream.close()
+            self.srv._conns.discard(self.sock)
             try:
                 self.sock.close()
             except OSError:

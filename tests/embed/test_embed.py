@@ -1,0 +1,242 @@
+"""embed.Config validation + StartEtcd boot + gateway forwarding
+(ref: server/embed/config_test.go, embed/etcd_test.go shapes)."""
+
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from etcd_tpu.client.client import Client
+from etcd_tpu.embed import Config, config_from_file, start_etcd
+from etcd_tpu.embed.config import ConfigError, member_id_from_urls, parse_urls
+from etcd_tpu.etcdmain import main as etcdmain_main
+from etcd_tpu.proxy.tcpproxy import TCPProxy
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def wait_until(pred, timeout=15.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestConfig:
+    def test_validate_defaults_need_data_dir(self):
+        with pytest.raises(ConfigError, match="data-dir"):
+            Config().validate()
+
+    def test_heartbeat_election_ratio(self):
+        cfg = Config(data_dir="/tmp/x", heartbeat_interval=300,
+                     election_timeout=1000)
+        with pytest.raises(ConfigError, match="5x"):
+            cfg.validate()
+
+    def test_name_must_be_in_initial_cluster(self):
+        cfg = Config(data_dir="/tmp/x", name="other",
+                     initial_cluster="a=http://localhost:2380")
+        with pytest.raises(ConfigError, match="not in"):
+            cfg.validate()
+
+    def test_parse_urls(self):
+        assert parse_urls("http://a:1,http://b:2") == [("a", 1), ("b", 2)]
+        with pytest.raises(ConfigError):
+            parse_urls("ftp://a:1")
+        with pytest.raises(ConfigError):
+            parse_urls("http://nohost")
+
+    def test_member_id_deterministic_and_distinct(self):
+        a = member_id_from_urls("http://x:1", "tok")
+        assert a == member_id_from_urls("http://x:1", "tok")
+        assert a != member_id_from_urls("http://x:2", "tok")
+        assert a != member_id_from_urls("http://x:1", "tok2")
+
+    def test_election_ticks(self):
+        cfg = Config(heartbeat_interval=100, election_timeout=1000)
+        assert cfg.election_ticks() == 10
+        assert cfg.tick_interval() == 0.1
+
+    def test_config_from_file(self, tmp_path):
+        p = tmp_path / "etcd.yaml"
+        p.write_text(
+            "name: m1\ndata-dir: /tmp/d\nheartbeat-interval: 50\n"
+            "election-timeout: 500\ninitial-cluster: m1=http://localhost:2380\n"
+        )
+        cfg = config_from_file(str(p))
+        assert cfg.name == "m1"
+        assert cfg.heartbeat_interval == 50
+        cfg.validate()
+
+    def test_config_from_file_unknown_key(self, tmp_path):
+        p = tmp_path / "etcd.yaml"
+        p.write_text("not-a-key: 1\n")
+        with pytest.raises(ConfigError, match="unknown config key"):
+            config_from_file(str(p))
+
+
+class TestStartEtcd:
+    def _cluster_cfgs(self, tmp_path, n=3):
+        peer_ports = free_ports(n)
+        client_ports = free_ports(n)
+        names = [f"m{i}" for i in range(n)]
+        initial = ",".join(
+            f"{nm}=http://127.0.0.1:{p}" for nm, p in zip(names, peer_ports)
+        )
+        cfgs = []
+        for i, nm in enumerate(names):
+            cfgs.append(Config(
+                name=nm,
+                data_dir=str(tmp_path / nm),
+                listen_peer_urls=f"http://127.0.0.1:{peer_ports[i]}",
+                listen_client_urls=f"http://127.0.0.1:{client_ports[i]}",
+                initial_cluster=initial,
+                heartbeat_interval=20,
+                election_timeout=200,
+            ))
+        return cfgs
+
+    def test_three_member_boot_and_kv(self, tmp_path):
+        cfgs = self._cluster_cfgs(tmp_path)
+        members = [start_etcd(c) for c in cfgs]
+        try:
+            wait_until(
+                lambda: any(m.server.is_leader() for m in members),
+                msg="leader election",
+            )
+            c = Client([m.client_addr for m in members])
+            c.put(b"embed", b"works")
+            assert c.get(b"embed").kvs[0].value == b"works"
+            # Health endpoint of every member answers.
+            for m in members:
+                h, p = m.metrics_addr
+                with urllib.request.urlopen(
+                    f"http://{h}:{p}/health?serializable=true", timeout=5
+                ) as r:
+                    assert json.loads(r.read())["health"] == "true"
+            c.close()
+        finally:
+            for m in members:
+                m.close()
+
+    def test_single_member_default_initial_cluster(self, tmp_path):
+        pp, cp = free_ports(2)
+        cfg = Config(
+            name="solo",
+            data_dir=str(tmp_path),
+            listen_peer_urls=f"http://127.0.0.1:{pp}",
+            listen_client_urls=f"http://127.0.0.1:{cp}",
+            initial_cluster=f"solo=http://127.0.0.1:{pp}",
+            heartbeat_interval=20,
+            election_timeout=200,
+        )
+        e = start_etcd(cfg)
+        try:
+            wait_until(lambda: e.server.is_leader(), msg="self-election")
+            c = Client([e.client_addr])
+            c.put(b"k", b"v")
+            assert c.get(b"k").kvs[0].value == b"v"
+            c.close()
+        finally:
+            e.close()
+
+
+class TestGateway:
+    def test_tcpproxy_round_robin_and_failover(self, tmp_path):
+        pp, cp = free_ports(2)
+        cfg = Config(
+            name="solo", data_dir=str(tmp_path),
+            listen_peer_urls=f"http://127.0.0.1:{pp}",
+            listen_client_urls=f"http://127.0.0.1:{cp}",
+            initial_cluster=f"solo=http://127.0.0.1:{pp}",
+            heartbeat_interval=20, election_timeout=200,
+        )
+        e = start_etcd(cfg)
+        dead_port = free_ports(1)[0]  # nothing listening
+        proxy = TCPProxy(
+            [("127.0.0.1", dead_port), e.client_addr],
+            monitor_interval=60.0,
+        )
+        try:
+            wait_until(lambda: e.server.is_leader(), msg="election")
+            # Every connection lands on the live endpoint (dead one gets
+            # inactivated on dial failure).
+            for i in range(3):
+                c = Client([proxy.addr])
+                c.put(f"gw{i}".encode(), b"x")
+                assert c.get(f"gw{i}".encode()).kvs[0].value == b"x"
+                c.close()
+        finally:
+            proxy.stop()
+            e.close()
+
+
+class TestEtcdMain:
+    def test_version_flag(self, capsys):
+        assert etcdmain_main(["--version"]) == 0
+        out = capsys.readouterr().out
+        assert "etcd_tpu Version" in out
+
+    def test_bare_gateway_prints_help(self, capsys):
+        assert etcdmain_main(["gateway"]) == 2
+        assert etcdmain_main(["grpc-proxy"]) == 2
+
+
+class TestConfigWiring:
+    def test_max_request_bytes_enforced(self, tmp_path):
+        pp, cp = free_ports(2)
+        cfg = Config(
+            name="solo", data_dir=str(tmp_path),
+            listen_peer_urls=f"http://127.0.0.1:{pp}",
+            listen_client_urls=f"http://127.0.0.1:{cp}",
+            initial_cluster=f"solo=http://127.0.0.1:{pp}",
+            heartbeat_interval=20, election_timeout=200,
+            max_request_bytes=4096,
+        )
+        e = start_etcd(cfg)
+        try:
+            wait_until(lambda: e.server.is_leader(), msg="election")
+            c = Client([e.client_addr])
+            c.put(b"small", b"x")  # fits
+            from etcd_tpu.client.client import ClientError
+
+            with pytest.raises(ClientError):
+                c.put(b"big", b"y" * 8192)
+            c.close()
+        finally:
+            e.close()
+
+    def test_hmac_auth_token_wired(self, tmp_path):
+        pp, cp = free_ports(2)
+        cfg = Config(
+            name="solo", data_dir=str(tmp_path),
+            listen_peer_urls=f"http://127.0.0.1:{pp}",
+            listen_client_urls=f"http://127.0.0.1:{cp}",
+            initial_cluster=f"solo=http://127.0.0.1:{pp}",
+            heartbeat_interval=20, election_timeout=200,
+            auth_token="hmac:secret-signing-key",
+        )
+        e = start_etcd(cfg)
+        try:
+            wait_until(lambda: e.server.is_leader(), msg="election")
+            from etcd_tpu.auth.hmac_token import HMACTokenProvider
+
+            assert isinstance(
+                e.server.auth_store.tp, HMACTokenProvider
+            )
+        finally:
+            e.close()

@@ -1,0 +1,126 @@
+"""grpcproxy: serializable-range caching, write invalidation, watch
+coalescing (ref: server/proxy/grpcproxy tests); tcpproxy forwarding."""
+
+import time
+
+import pytest
+
+from etcd_tpu.client.client import Client
+from etcd_tpu.proxy.grpcproxy import GrpcProxy
+from etcd_tpu.raftexample.transport import InProcNetwork
+from etcd_tpu.server import EtcdServer, ServerConfig
+from etcd_tpu.v3rpc.service import V3RPCServer
+
+from ..server.test_etcdserver import wait_until
+
+
+@pytest.fixture()
+def backend(tmp_path):
+    net = InProcNetwork()
+    srv = EtcdServer(
+        ServerConfig(
+            member_id=1, peers=[1], data_dir=str(tmp_path),
+            network=net, tick_interval=0.01,
+        )
+    )
+    rpc = V3RPCServer(srv, bind=("127.0.0.1", 0))
+    wait_until(lambda: srv.is_leader(), msg="leader")
+    yield srv, rpc
+    rpc.stop()
+    srv.stop()
+
+
+class TestGrpcProxy:
+    def test_passthrough_kv(self, backend):
+        srv, rpc = backend
+        proxy = GrpcProxy([rpc.addr])
+        try:
+            c = Client([proxy.addr])
+            c.put(b"pk", b"pv")
+            assert c.get(b"pk").kvs[0].value == b"pv"
+            c.delete(b"pk")
+            assert c.get(b"pk").count == 0
+            c.close()
+        finally:
+            proxy.stop()
+
+    def test_serializable_range_cache_and_invalidation(self, backend):
+        srv, rpc = backend
+        proxy = GrpcProxy([rpc.addr])
+        try:
+            c = Client([proxy.addr])
+            c.put(b"ck", b"v1")
+            r1 = c.get(b"ck", serializable=True)
+            assert r1.kvs[0].value == b"v1"
+            misses0 = proxy.cache.misses
+            r2 = c.get(b"ck", serializable=True)
+            assert r2.kvs[0].value == b"v1"
+            assert proxy.cache.hits >= 1
+            assert proxy.cache.misses == misses0
+            # A write through the proxy invalidates.
+            c.put(b"ck", b"v2")
+            r3 = c.get(b"ck", serializable=True)
+            assert r3.kvs[0].value == b"v2"
+            c.close()
+        finally:
+            proxy.stop()
+
+    def test_watch_coalescing_single_upstream(self, backend):
+        srv, rpc = backend
+        proxy = GrpcProxy([rpc.addr])
+        try:
+            c1 = Client([proxy.addr])
+            c2 = Client([proxy.addr])
+            h1 = c1.watch(b"wk")
+            h2 = c2.watch(b"wk")
+            # Both watchers share ONE upstream broadcast.
+            assert len(proxy._bcasts) == 1
+            writer = Client([rpc.addr])
+            writer.put(b"wk", b"fanout")
+            got1 = h1.get(timeout=5)
+            got2 = h2.get(timeout=5)
+            assert got1 is not None and got2 is not None
+            assert got1[1][0].kv.value == b"fanout"
+            assert got2[1][0].kv.value == b"fanout"
+            h1.cancel()
+            h2.cancel()
+            wait_until(lambda: len(proxy._bcasts) == 0,
+                       msg="broadcast teardown")
+            writer.close()
+            c1.close()
+            c2.close()
+        finally:
+            proxy.stop()
+
+    def test_historical_watch_dedicated(self, backend):
+        srv, rpc = backend
+        writer = Client([rpc.addr])
+        writer.put(b"hk", b"old")
+        rev_after = writer.get(b"hk").header.revision
+        proxy = GrpcProxy([rpc.addr])
+        try:
+            c = Client([proxy.addr])
+            h = c.watch(b"hk", start_rev=rev_after)  # replay from history
+            got = h.get(timeout=5)
+            assert got is not None
+            assert got[1][0].kv.value == b"old"
+            assert len(proxy._bcasts) == 0  # dedicated, not coalesced
+            h.cancel()
+            c.close()
+        finally:
+            proxy.stop()
+            writer.close()
+
+    def test_compaction_through_proxy(self, backend):
+        srv, rpc = backend
+        proxy = GrpcProxy([rpc.addr])
+        try:
+            c = Client([proxy.addr])
+            for i in range(5):
+                c.put(b"comp", str(i).encode())
+            rev = c.get(b"comp").header.revision
+            c.compact(rev)
+            assert proxy.cache.compact_rev == rev
+            c.close()
+        finally:
+            proxy.stop()

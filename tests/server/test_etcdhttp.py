@@ -91,6 +91,7 @@ class TestEtcdHTTP:
             assert "[+]leader ok" in body
 
             code, body = _get(http.addr, "/metrics")
+            assert code == 200
             assert "etcd_mvcc_db_total_size_in_bytes" in body
             assert "etcd_debugging_mvcc_current_revision" in body
 
