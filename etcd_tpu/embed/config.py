@@ -103,6 +103,8 @@ class Config:
                 f"initial-cluster-state must be new|existing, "
                 f"got {self.initial_cluster_state!r}"
             )
+        if self.heartbeat_interval <= 0:
+            raise ConfigError("heartbeat interval must be positive")
         if 5 * self.heartbeat_interval > self.election_timeout:
             raise ConfigError(
                 "election timeout should be at least 5x the heartbeat interval"

@@ -69,6 +69,15 @@ class Etcd:
 def start_etcd(cfg: Config) -> Etcd:
     """ref: embed/etcd.go:93 StartEtcd."""
     cfg.validate()
+    import os
+
+    if os.environ.get("ETCD_VERIFY") == "all" and os.path.isdir(cfg.data_dir):
+        # Data-dir invariants checked before boot when enabled
+        # (ref: server/verify/verify.go VerifyIfEnabled, ETCD_VERIFY env).
+        from ..etcdutl import verify as _verify
+
+        if not _verify(cfg.data_dir):
+            raise RuntimeError(f"ETCD_VERIFY failed for {cfg.data_dir}")
     e = Etcd(cfg)
 
     cluster = cfg.initial_cluster_map()  # name -> peer urls

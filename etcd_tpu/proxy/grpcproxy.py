@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..client.client import Client, ClientError
 from ..v3rpc import wire
+from ..v3rpc.connbase import FramedServerConn
 
 DEFAULT_CACHE_ENTRIES = 2048  # ref: cache/store.go DefaultMaxEntries
 
@@ -39,6 +40,7 @@ class _RangeCache:
         self.compact_rev = 0
         self.hits = 0
         self.misses = 0
+        self.gen = 0  # bumped on invalidate; stale fetches don't re-insert
 
     @staticmethod
     def _key(params: Dict) -> str:
@@ -66,13 +68,18 @@ class _RangeCache:
             self.hits += 1
             return resp
 
-    def put(self, params: Dict, resp: Dict) -> None:
+    def put(self, params: Dict, resp: Dict, gen: int) -> None:
+        """Insert only if no invalidation happened since `gen` was read
+        (a concurrent write may have made this response stale)."""
         rev = params.get("revision", 0) or 0
         with self._lock:
+            if gen != self.gen:
+                return
             if 0 < rev < self.compact_rev:
                 return
-            self._od[self._key(params)] = resp
-            self._od.move_to_end(self._key(params))
+            k = self._key(params)
+            self._od[k] = resp
+            self._od.move_to_end(k)
             while len(self._od) > self.max_entries:
                 self._od.popitem(last=False)
 
@@ -81,10 +88,12 @@ class _RangeCache:
         # dropping everything is strictly safer and keeps this host-side
         # path simple.
         with self._lock:
+            self.gen += 1
             self._od.clear()
 
     def compacted(self, rev: int) -> None:
         with self._lock:
+            self.gen += 1
             self.compact_rev = max(self.compact_rev, rev)
             self._od.clear()
 
@@ -126,7 +135,12 @@ class _Broadcast:
             with self.lock:
                 subs = list(self.subs.items())
             for (cid, wid), conn in subs:
-                conn.push_event(wid, rev, events)
+                if not conn.push_event(wid, rev, events):
+                    # Dead or stalled downstream (send timed out): drop
+                    # this subscriber so others keep receiving.
+                    self.proxy.release_broadcast(
+                        conn=conn, wid=wid, key=None, end=None, bcast=self
+                    )
 
 
 class GrpcProxy:
@@ -190,29 +204,47 @@ class GrpcProxy:
             b.add(conn, wid)
             return b
 
-    def release_broadcast(self, key: bytes, end: Optional[bytes],
-                          conn: "_ProxyConn", wid: int) -> None:
+    def release_broadcast(self, key: Optional[bytes], end: Optional[bytes],
+                          conn: "_ProxyConn", wid: int,
+                          bcast: Optional[_Broadcast] = None) -> None:
         with self._bcast_lock:
-            b = self._bcasts.get((key, end))
+            if bcast is not None:
+                b = bcast
+                keys = [k for k, v in self._bcasts.items() if v is b]
+                key_tuple = keys[0] if keys else None
+            else:
+                key_tuple = (key, end)
+                b = self._bcasts.get(key_tuple)
             if b is not None and b.remove(conn, wid):
                 b.stop()
-                del self._bcasts[(key, end)]
+                if key_tuple is not None:
+                    self._bcasts.pop(key_tuple, None)
 
 
-class _ProxyConn:
+class _ProxyConn(FramedServerConn):
     """One downstream client connection."""
+
+    SEND_TIMEOUT_S = 5  # stalled-watcher bound: sendall fails after this
 
     def __init__(self, proxy: GrpcProxy, sock: socket.socket) -> None:
         self.p = proxy
-        self.sock = sock
-        self.wlock = threading.Lock()
         self._wstate = threading.Lock()  # guards _next_wid + _wlocal
         self._next_wid = 0
         self._wlocal: Dict[int, Tuple[bytes, Optional[bytes], Any]] = {}
-        threading.Thread(target=self._read_loop, daemon=True).start()
+        self._pending_pumps: Dict[int, Any] = {}  # wid -> handle (start after response)
+        import struct as _struct
+
+        try:
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                _struct.pack("ll", self.SEND_TIMEOUT_S, 0),
+            )
+        except OSError:
+            pass
+        super().__init__(sock, proxy._stopped)
 
     def push_event(self, wid: int, revision: int, events) -> bool:
-        return self._send({
+        return self.send_frame({
             "stream": wid,
             "event": {
                 "revision": revision,
@@ -220,48 +252,35 @@ class _ProxyConn:
             },
         })
 
-    def _send(self, obj: Dict[str, Any]) -> bool:
-        try:
-            with self.wlock:
-                wire.write_frame(self.sock, obj)
-            return True
-        except OSError:
-            return False
+    def encode_error(self, e: Exception) -> Dict[str, str]:
+        if isinstance(e, ClientError):
+            return {"type": e.etype, "msg": e.msg}
+        return super().encode_error(e)
 
-    def _read_loop(self) -> None:
-        try:
-            while not self.p._stopped.is_set():
-                req = wire.read_frame(self.sock)
-                if req is None:
-                    return
-                threading.Thread(
-                    target=self._handle, args=(req,), daemon=True
-                ).start()
-        finally:
-            with self._wstate:
-                wids = list(self._wlocal)
-            for wid in wids:
-                self._cancel_watch(wid)
-            self.p._conns.discard(self.sock)
-            try:
-                self.sock.close()
-            except OSError:
-                pass
+    def on_close(self) -> None:
+        with self._wstate:
+            wids = list(self._wlocal)
+        for wid in wids:
+            self._cancel_watch(wid)
+        self.p._conns.discard(self.sock)
 
-    def _handle(self, req: Dict[str, Any]) -> None:
-        rid = req.get("id")
-        method = req.get("method", "")
-        params = req.get("params", {}) or {}
-        token = req.get("token")
-        try:
-            result = self._dispatch(method, params, token)
-            self._send({"id": rid, "result": result})
-        except ClientError as e:
-            self._send({"id": rid, "error": {"type": e.etype, "msg": e.msg}})
-        except Exception as e:  # noqa: BLE001
-            self._send(
-                {"id": rid, "error": {"type": type(e).__name__, "msg": str(e)}}
-            )
+    def after_send(self, method: str, params: Dict, result: Any) -> None:
+        # Historical-watch pumps start only AFTER the WatchCreate
+        # response frame is on the wire, or replayed events could beat
+        # the watch_id back to the client and be dropped there.
+        if method != "WatchCreate":
+            return
+        wid = result.get("watch_id")
+        with self._wstate:
+            h = self._pending_pumps.pop(wid, None)
+        if h is not None:
+            threading.Thread(
+                target=self._dedicated_pump, args=(wid, h), daemon=True
+            ).start()
+
+    def dispatch(self, method: str, params: Dict,
+                 token: Optional[str] = None) -> Any:
+        return self._dispatch(method, params, token)
 
     # -- dispatch --------------------------------------------------------------
 
@@ -274,8 +293,9 @@ class _ProxyConn:
             cached = p.cache.get(params)
             if cached is not None:
                 return cached
+            gen = p.cache.gen
             resp = p.client._request("Range", params)
-            p.cache.put(params, resp)
+            p.cache.put(params, resp, gen)
             return resp
         if method in ("Put", "DeleteRange", "Txn"):
             resp = p.client._request(method, params, token=token)
@@ -308,13 +328,12 @@ class _ProxyConn:
             with self._wstate:
                 self._wlocal[wid] = (key, end, None)
         else:
-            # Historical watch: dedicated upstream stream.
+            # Historical watch: dedicated upstream stream; the pump
+            # starts in after_send (response frame must go first).
             h = self.p.client.watch(key, end, start_rev=start_rev)
             with self._wstate:
                 self._wlocal[wid] = (key, end, h)
-            threading.Thread(
-                target=self._dedicated_pump, args=(wid, h), daemon=True
-            ).start()
+                self._pending_pumps[wid] = h
         return {"watch_id": wid, "revision": 0}
 
     def _dedicated_pump(self, wid: int, h) -> None:
@@ -329,6 +348,7 @@ class _ProxyConn:
     def _cancel_watch(self, wid: int) -> None:
         with self._wstate:
             ent = self._wlocal.pop(wid, None)
+            self._pending_pumps.pop(wid, None)
         if ent is None:
             return
         key, end, dedicated = ent

@@ -1,4 +1,9 @@
-"""mvcc metric set (ref: server/storage/mvcc/metrics.go)."""
+"""mvcc metric set (ref: server/storage/mvcc/metrics.go).
+
+Process-global like the reference's prometheus registry (one member per
+process is the deployment model); in-proc multi-member test clusters
+share these, so gauges mix members — assert on per-store state, not
+gauges, in such harnesses."""
 
 from __future__ import annotations
 

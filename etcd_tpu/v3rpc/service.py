@@ -19,6 +19,7 @@ from ..server import api as sapi
 from ..server import metrics as smet
 from ..server.membership import Member
 from . import wire
+from .connbase import FramedServerConn
 
 
 class V3RPCServer:
@@ -64,61 +65,31 @@ class V3RPCServer:
             _Conn(self, conn)
 
 
-class _Conn:
+class _Conn(FramedServerConn):
+    recv_counter = staticmethod(smet.client_grpc_received_bytes.inc)
+    sent_counter = staticmethod(smet.client_grpc_sent_bytes.inc)
+
     def __init__(self, srv: V3RPCServer, sock: socket.socket) -> None:
         self.srv = srv
-        self.sock = sock
-        self.wlock = threading.Lock()
         self.watch_stream = None
         self._watch_poller: Optional[threading.Thread] = None
-        threading.Thread(target=self._read_loop, daemon=True).start()
+        super().__init__(sock, srv._stopped)
 
     def _send(self, obj: Dict[str, Any]) -> bool:
-        try:
-            with self.wlock:
-                n = wire.write_frame(self.sock, obj)
-            smet.client_grpc_sent_bytes.inc(n)
-            return True
-        except OSError:
-            return False
+        return self.send_frame(obj)
 
-    def _read_loop(self) -> None:
-        try:
-            while not self.srv._stopped.is_set():
-                req = wire.read_frame(
-                    self.sock, counter=smet.client_grpc_received_bytes.inc
-                )
-                if req is None:
-                    return
-                threading.Thread(
-                    target=self._handle, args=(req,), daemon=True
-                ).start()
-        finally:
-            if self.watch_stream is not None:
-                self.watch_stream.close()
-            self.srv._conns.discard(self.sock)
-            try:
-                self.sock.close()
-            except OSError:
-                pass
+    def encode_result(self, result: Any) -> Any:
+        return wire.enc(result)
 
-    def _handle(self, req: Dict[str, Any]) -> None:
-        rid = req.get("id")
-        method = req.get("method", "")
-        params = req.get("params", {}) or {}
-        token = req.get("token")
-        try:
-            result = self._dispatch(method, params, token)
-            self._send({"id": rid, "result": wire.enc(result)})
-        except Exception as e:  # noqa: BLE001 — typed error to the client
-            self._send(
-                {
-                    "id": rid,
-                    "error": {"type": type(e).__name__, "msg": str(e)},
-                }
-            )
+    def on_close(self) -> None:
+        if self.watch_stream is not None:
+            self.watch_stream.close()
+        self.srv._conns.discard(self.sock)
 
     # -- dispatch --------------------------------------------------------------
+
+    def dispatch(self, method: str, params: Dict, token: Optional[str]):
+        return self._dispatch(method, params, token)
 
     def _dispatch(self, method: str, params: Dict, token: Optional[str]):
         s = self.srv.s

@@ -1,0 +1,204 @@
+"""etcdctl command coverage against a live member
+(ref: etcdctl/ctlv3/command tests + tests/e2e/ctl_v3_* shapes)."""
+
+import io
+import json
+
+import pytest
+
+from etcd_tpu.etcdctl import main as ctl, parse_txn
+from etcd_tpu.raftexample.transport import InProcNetwork
+from etcd_tpu.server import EtcdServer, ServerConfig
+from etcd_tpu.server import api as sapi
+from etcd_tpu.v3rpc.service import V3RPCServer
+
+from ..server.test_etcdserver import wait_until
+
+
+@pytest.fixture(scope="module")
+def member(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ctl")
+    net = InProcNetwork()
+    srv = EtcdServer(
+        ServerConfig(
+            member_id=1, peers=[1], data_dir=str(tmp),
+            network=net, tick_interval=0.01,
+        )
+    )
+    rpc = V3RPCServer(srv, bind=("127.0.0.1", 0))
+    wait_until(lambda: srv.is_leader(), msg="leader")
+    yield srv, rpc
+    rpc.stop()
+    srv.stop()
+
+
+def run(member, *argv, stdin=None):
+    srv, rpc = member
+    ep = f"{rpc.addr[0]}:{rpc.addr[1]}"
+    import contextlib
+    import sys
+
+    out = io.StringIO()
+    old_stdin = sys.stdin
+    if stdin is not None:
+        sys.stdin = io.StringIO(stdin)
+    try:
+        with contextlib.redirect_stdout(out):
+            rc = ctl(["--endpoints", ep, *argv])
+    finally:
+        sys.stdin = old_stdin
+    return rc, out.getvalue()
+
+
+class TestKV:
+    def test_put_get_del(self, member):
+        rc, out = run(member, "put", "ctlk", "ctlv")
+        assert rc == 0 and "OK" in out
+        rc, out = run(member, "get", "ctlk")
+        assert rc == 0 and out == "ctlk\nctlv\n"
+        rc, out = run(member, "get", "ctlk", "--print-value-only")
+        assert out == "ctlv\n"
+        rc, out = run(member, "del", "ctlk")
+        assert rc == 0 and out.strip() == "1"
+
+    def test_get_prefix_sorted_json(self, member):
+        for i in (3, 1, 2):
+            run(member, "put", f"pfx{i}", f"v{i}")
+        rc, out = run(member, "get", "pfx", "--prefix", "--order", "DESCEND")
+        keys = out.splitlines()[::2]
+        assert keys == ["pfx3", "pfx2", "pfx1"]
+        rc, out = run(member, "-w", "json", "get", "pfx1")
+        d = json.loads(out)
+        assert d["count"] == 1
+
+    def test_get_count_keys_only(self, member):
+        run(member, "put", "cnt1", "x")
+        run(member, "put", "cnt2", "x")
+        rc, out = run(member, "get", "cnt", "--prefix", "--count-only")
+        assert out.strip() == "2"
+        rc, out = run(member, "get", "cnt", "--prefix", "--keys-only")
+        assert out.splitlines() == ["cnt1", "cnt2"]
+
+    def test_txn(self, member):
+        run(member, "put", "txnk", "old")
+        stdin = (
+            'value("txnk") = "old"\n'
+            "\n"
+            "put txnk new\n"
+            "\n"
+            "get txnk\n"
+        )
+        rc, out = run(member, "txn", stdin=stdin)
+        assert rc == 0
+        assert out.startswith("SUCCEEDED")
+        rc, out = run(member, "get", "txnk", "--print-value-only")
+        assert out == "new\n"
+
+    def test_parse_txn_grammar(self):
+        req = parse_txn([
+            'mod("a") > "5"',
+            'create("b") = "0"',
+            "",
+            "put k v with spaces",
+            "del x",
+            "",
+            "get y",
+        ])
+        assert len(req.compare) == 2
+        assert req.compare[0].target == sapi.CompareTarget.MOD
+        assert req.compare[0].result == sapi.CompareResult.GREATER
+        assert req.success[0].request_put.value == b"v with spaces"
+        assert req.success[1].request_delete_range.key == b"x"
+        assert req.failure[0].request_range.key == b"y"
+
+    def test_compaction(self, member):
+        run(member, "put", "compk", "1")
+        srv, _ = member
+        rev = srv.kv.rev()
+        rc, out = run(member, "compaction", str(rev))
+        assert rc == 0 and f"compacted revision {rev}" in out
+
+    def test_watch_max_events(self, member):
+        # The put goes through a raw Client: run() redirects the
+        # process-wide stdout, so only ONE run() may be active at once.
+        import threading
+        import time
+
+        from etcd_tpu.client.client import Client
+
+        results = {}
+
+        def bg():
+            results["r"] = run(member, "watch", "wkey", "--max-events", "1")
+
+        t = threading.Thread(target=bg)
+        t.start()
+        time.sleep(0.5)
+        _, rpc = member
+        c = Client([rpc.addr])
+        c.put(b"wkey", b"wval")
+        c.close()
+        t.join(timeout=10)
+        rc, out = results["r"]
+        assert rc == 0
+        assert out == "PUT\nwkey\nwval\n"
+
+
+class TestLeaseMemberEndpoint:
+    def test_lease_lifecycle(self, member):
+        rc, out = run(member, "lease", "grant", "60")
+        assert rc == 0
+        lid = out.split()[1]
+        rc, out = run(member, "lease", "timetolive", lid)
+        assert "granted with TTL(60s)" in out
+        rc, out = run(member, "lease", "keep-alive", lid, "--once")
+        assert "keepalived" in out
+        rc, out = run(member, "lease", "list")
+        assert lid in out
+        rc, out = run(member, "lease", "revoke", lid)
+        assert "revoked" in out
+
+    def test_member_list_table(self, member):
+        rc, out = run(member, "member", "list")
+        assert rc == 0 and "m1" in out
+        rc, out = run(member, "-w", "table", "member", "list")
+        assert "| ID" in out or "| 1 " in out
+
+    def test_endpoint_health_status(self, member):
+        rc, out = run(member, "endpoint", "health")
+        assert rc == 0 and "is healthy" in out
+        rc, out = run(member, "endpoint", "status")
+        assert rc == 0 and "true" in out  # leader column
+        rc, out = run(member, "endpoint", "hashkv")
+        assert rc == 0
+
+    def test_alarm_and_defrag(self, member):
+        rc, out = run(member, "alarm", "list")
+        assert rc == 0
+        rc, out = run(member, "defrag")
+        assert rc == 0 and "Finished defragmenting" in out
+
+    def test_move_leader_single_noop(self, member):
+        srv, _ = member
+        rc, out = run(member, "move-leader", f"{srv.id:x}")
+        # transferring to self: raft ignores; command still succeeds
+        assert rc == 0
+
+    def test_version(self, member):
+        rc, out = run(member, "version")
+        assert rc == 0 and "etcdctl version" in out
+
+    def test_check_perf_small(self, member):
+        rc, out = run(member, "check", "perf", "--load", "s")
+        assert rc == 0 and "PASS" in out
+
+
+class TestLockElect:
+    def test_lock_prints_key(self, member):
+        rc, out = run(member, "lock", "mylock")
+        assert rc == 0
+        assert out.startswith("mylock/")
+
+    def test_elect_campaign_and_listen(self, member):
+        rc, out = run(member, "elect", "myelec", "leader-a")
+        assert rc == 0 and out.startswith("myelec/")
