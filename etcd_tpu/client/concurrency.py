@@ -265,11 +265,4 @@ class STMTxn:
         return resp if resp.succeeded else None
 
 
-def _prefix_end(prefix: bytes) -> bytes:
-    """ref: clientv3.GetPrefixRangeEnd."""
-    end = bytearray(prefix)
-    for i in range(len(end) - 1, -1, -1):
-        if end[i] < 0xFF:
-            end[i] += 1
-            return bytes(end[: i + 1])
-    return b"\x00"
+from .util import prefix_end as _prefix_end  # noqa: E402 — shared helper

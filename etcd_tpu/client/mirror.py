@@ -10,15 +10,7 @@ from typing import Iterator, List, Optional, Tuple
 from ..server import api as sapi
 from ..storage.mvcc.kv import Event, EventType
 from .client import Client
-
-
-def _prefix_end(prefix: bytes) -> bytes:
-    b = bytearray(prefix)
-    for i in reversed(range(len(b))):
-        if b[i] < 0xFF:
-            b[i] += 1
-            return bytes(b[: i + 1])
-    return b"\x00"
+from .util import prefix_end
 
 
 class Syncer:
@@ -33,8 +25,10 @@ class Syncer:
     def sync_base(self) -> Tuple[int, List[sapi.KeyValue]]:
         """One consistent snapshot of the prefix: (revision, kvs)
         (ref: syncer.go SyncBase — paginated range pinned at one rev)."""
+        # Empty prefix mirrors the whole keyspace: [\x00, open-end)
+        # (syncer.go uses the same "\x00" + open-end sentinel pair).
         key = self.prefix if self.prefix else b"\x00"
-        end = _prefix_end(self.prefix) if self.prefix else b"\x00"
+        end = prefix_end(self.prefix)
         resp = self.c.get(key, end, revision=self.rev)
         at_rev = self.rev or resp.header.revision
         kvs = list(resp.kvs)
@@ -51,16 +45,17 @@ class Syncer:
         if self.rev == 0:
             raise ValueError("call sync_base first (rev unset)")
         key = self.prefix if self.prefix else b"\x00"
-        end = _prefix_end(self.prefix) if self.prefix else b"\x00"
+        end = prefix_end(self.prefix)
         return self.c.watch(key, end, start_rev=self.rev + 1)
 
     # -- make-mirror (etcdctl) -------------------------------------------------
 
     def mirror_to(self, dest: Client, dest_prefix: Optional[bytes] = None,
-                  max_txns: int = 0) -> int:
+                  max_txns: int = 0, base_only: bool = False) -> int:
         """Copy base then stream updates into `dest`; returns keys
-        mirrored. max_txns>0 bounds the update phase (testing/one-shot);
-        0 streams until interrupted (ref: make_mirror_command.go)."""
+        mirrored. base_only skips the update stream; max_txns>0 bounds
+        the update phase (testing/one-shot); max_txns=0 streams until
+        interrupted (ref: make_mirror_command.go)."""
         rev, kvs = self.sync_base()
         self.rev = rev
 
@@ -73,12 +68,12 @@ class Syncer:
         for kv in kvs:
             dest.put(rewrite(kv.key), kv.value)
             count += 1
-        if max_txns == 0:
+        if base_only:
             return count
         h = self.sync_updates()
         try:
             applied = 0
-            while applied < max_txns:
+            while max_txns == 0 or applied < max_txns:
                 got = h.get(timeout=0.5)
                 if got is None:
                     continue

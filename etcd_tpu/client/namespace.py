@@ -23,13 +23,7 @@ def _prefix_interval(pfx: bytes, key: bytes, end: bytes) -> tuple:
     return pkey, pend
 
 
-def _prefix_end(prefix: bytes) -> bytes:
-    end = bytearray(prefix)
-    for i in range(len(end) - 1, -1, -1):
-        if end[i] < 0xFF:
-            end[i] += 1
-            return bytes(end[: i + 1])
-    return b"\x00"
+from .util import prefix_end as _prefix_end  # noqa: E402 — shared helper
 
 
 class NamespacedClient:

@@ -1,0 +1,70 @@
+"""Ordering guard: reject responses that travel back in time
+(ref: client/v3/ordering/kv.go + util.go — tracks the max revision seen
+and errors when a (possibly stale, failed-over) server answers with an
+older one).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..server import api as sapi
+from .client import Client
+
+
+class OrderViolationError(Exception):
+    """ref: ordering.ErrNoGreaterRev."""
+
+
+def new_order_violation_switch_endpoint_closure(client: Client):
+    """The reference's remedy: rotate to another endpoint and retry once
+    (ordering/util.go NewOrderViolationSwitchEndpointClosure)."""
+
+    def fix(_err: OrderViolationError) -> None:
+        client._rotate_endpoint()
+
+    return fix
+
+
+class OrderingKV:
+    """Wraps a Client's read path with the monotonic-revision check."""
+
+    def __init__(self, client: Client,
+                 violation_fn: Optional[Callable] = None) -> None:
+        self.c = client
+        self.violation_fn = violation_fn
+        self._lock = threading.Lock()
+        self._prev_rev = 0
+
+    def _check(self, header: sapi.ResponseHeader):
+        with self._lock:
+            if header.revision < self._prev_rev:
+                err = OrderViolationError(
+                    f"revision {header.revision} < previously seen "
+                    f"{self._prev_rev}"
+                )
+                if self.violation_fn is not None:
+                    self.violation_fn(err)
+                raise err
+            self._prev_rev = max(self._prev_rev, header.revision)
+
+    def get(self, key: bytes, **kw) -> sapi.RangeResponse:
+        resp = self.c.get(key, **kw)
+        self._check(resp.header)
+        return resp
+
+    def put(self, key: bytes, value: bytes, **kw) -> sapi.PutResponse:
+        resp = self.c.put(key, value, **kw)
+        self._check(resp.header)
+        return resp
+
+    def delete(self, key: bytes, **kw) -> sapi.DeleteRangeResponse:
+        resp = self.c.delete(key, **kw)
+        self._check(resp.header)
+        return resp
+
+    def txn(self, req: sapi.TxnRequest) -> sapi.TxnResponse:
+        resp = self.c.txn(req)
+        self._check(resp.header)
+        return resp

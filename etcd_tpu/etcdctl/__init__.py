@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import version as ver
 from ..client.client import Client, ClientError
+from ..client.util import prefix_end as _prefix_end
 from ..server import api as sapi
 
 
@@ -39,14 +40,6 @@ def _parse_endpoints(s: str) -> List[Tuple[str, int]]:
     return out
 
 
-def _prefix_end(prefix: bytes) -> bytes:
-    """ref: clientv3.GetPrefixRangeEnd."""
-    b = bytearray(prefix)
-    for i in reversed(range(len(b))):
-        if b[i] < 0xFF:
-            b[i] += 1
-            return bytes(b[: i + 1])
-    return b"\x00"
 
 
 # -- printers (etcdctl/ctlv3/command/printer*.go) ------------------------------
@@ -460,7 +453,9 @@ def cmd_member(args, pr: Printer) -> int:
             peer_urls = args.peer_urls.split(",")
             from ..embed.config import member_id_from_urls
 
-            mid = member_id_from_urls(args.peer_urls, "")
+            # Token must match the cluster's --initial-cluster-token or
+            # the booting member derives a different self-ID.
+            mid = member_id_from_urls(args.peer_urls, args.cluster_token)
             members = c.member_add(
                 mid, name=args.member_name, peer_urls=peer_urls,
                 is_learner=args.learner,
@@ -873,6 +868,7 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("member_name")
     x.add_argument("--peer-urls", required=True)
     x.add_argument("--learner", action="store_true")
+    x.add_argument("--cluster-token", default="etcd-cluster")
     x = msub.add_parser("remove")
     x.add_argument("id")
     x = msub.add_parser("promote")

@@ -51,6 +51,11 @@ def snapshot_restore(
 
     if not os.path.exists(snap_file):
         raise FileNotFoundError(snap_file)
+    if not skip_hash_check:
+        # Integrity check before touching anything (the reference
+        # verifies the snapshot's trailing hash; our snapshot is the
+        # backend db, so ask the storage engine directly).
+        _check_snapshot_integrity(snap_file)
     cluster_map = {}
     if initial_cluster:
         for part in initial_cluster.split(","):
@@ -92,6 +97,30 @@ def snapshot_restore(
         be.close()
     print(f"restored snapshot to {member_dir} (member {my_id:x})")
     return 0
+
+
+def _check_snapshot_integrity(snap_file: str) -> None:
+    import sqlite3
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = os.path.join(td, "db")
+        shutil.copyfile(snap_file, tmp)
+        conn = sqlite3.connect(tmp)
+        try:
+            rows = conn.execute("PRAGMA integrity_check").fetchall()
+        except sqlite3.DatabaseError as e:
+            raise ValueError(
+                f"snapshot integrity check failed: {e} "
+                f"(use --skip-hash-check to override)"
+            )
+        finally:
+            conn.close()
+    if rows != [("ok",)]:
+        raise ValueError(
+            f"snapshot integrity check failed: {rows!r} "
+            f"(use --skip-hash-check to override)"
+        )
 
 
 def snapshot_status(snap_file: str, write_out: str = "simple") -> int:

@@ -265,18 +265,25 @@ class _ProxyConn(FramedServerConn):
         self.p._conns.discard(self.sock)
 
     def after_send(self, method: str, params: Dict, result: Any) -> None:
-        # Historical-watch pumps start only AFTER the WatchCreate
-        # response frame is on the wire, or replayed events could beat
-        # the watch_id back to the client and be dropped there.
+        # Event delivery starts only AFTER the WatchCreate response
+        # frame is on the wire, or events could beat the watch_id back
+        # to the client and be dropped there (client registers the
+        # handle only once the response returns).
         if method != "WatchCreate":
             return
         wid = result.get("watch_id")
         with self._wstate:
-            h = self._pending_pumps.pop(wid, None)
-        if h is not None:
+            pend = self._pending_pumps.pop(wid, None)
+        if pend is None:
+            return
+        kind, payload = pend
+        if kind == "dedicated":
             threading.Thread(
-                target=self._dedicated_pump, args=(wid, h), daemon=True
+                target=self._dedicated_pump, args=(wid, payload), daemon=True
             ).start()
+        else:  # broadcast join deferred until now
+            key, end = payload
+            self.p.broadcast_join(key, end, self, wid)
 
     def dispatch(self, method: str, params: Dict,
                  token: Optional[str] = None) -> Any:
@@ -324,16 +331,16 @@ class _ProxyConn(FramedServerConn):
             wid = self._next_wid
             self._next_wid += 1
         if start_rev == 0:
-            self.p.broadcast_join(key, end, self, wid)
             with self._wstate:
                 self._wlocal[wid] = (key, end, None)
+                self._pending_pumps[wid] = ("broadcast", (key, end))
         else:
             # Historical watch: dedicated upstream stream; the pump
             # starts in after_send (response frame must go first).
             h = self.p.client.watch(key, end, start_rev=start_rev)
             with self._wstate:
                 self._wlocal[wid] = (key, end, h)
-                self._pending_pumps[wid] = h
+                self._pending_pumps[wid] = ("dedicated", h)
         return {"watch_id": wid, "revision": 0}
 
     def _dedicated_pump(self, wid: int, h) -> None:

@@ -160,7 +160,7 @@ class ApplierBackend:
             txn = self.s.kv.write()
             txn.__enter__()
         try:
-            end = dr.range_end if dr.range_end else None
+            end = _resolve_end(dr.range_end)
             if dr.prev_kv:
                 rr = txn.range(dr.key, end, RangeOptions(limit=0))
                 resp.prev_kvs = rr.kvs
@@ -175,7 +175,7 @@ class ApplierBackend:
         """ref: apply.go:334-439 Range."""
         mmet.range_total.inc()
         resp = RangeResponse(header=self._header())
-        end = rreq.range_end if rreq.range_end else None
+        end = _resolve_end(rreq.range_end)
 
         limit = rreq.limit
         if (
@@ -268,7 +268,7 @@ class ApplierBackend:
 
     def _apply_compare(self, c: Compare, txn) -> bool:
         """ref: apply.go applyCompare."""
-        end = c.range_end if c.range_end else None
+        end = _resolve_end(c.range_end)
         src = txn if txn is not None else self.s.kv
         rr = src.range(c.key, end, RangeOptions())
         if not rr.kvs:
@@ -378,6 +378,18 @@ class ApplierBackend:
         else:
             raise ValueError(f"unknown auth op {op!r}")
         return {"revision": st.revision()}
+
+
+def _resolve_end(range_end: bytes) -> Optional[bytes]:
+    """etcd range_end semantics (ref: rpc.proto RangeRequest doc):
+    b"" → the single key; b"\\x00" → open end (every key ≥ key, the
+    'range over all keys ≥ key' sentinel); else literal exclusive end.
+    Internally None = single key, b"" = open end."""
+    if not range_end:
+        return None
+    if range_end == b"\x00":
+        return b""
+    return range_end
 
 
 def _is_txn_write(tr: TxnRequest) -> bool:

@@ -59,7 +59,9 @@ class TreeIndex:
                   limit: int = 0) -> Tuple[List[Revision], int]:
         """Mod-revisions of keys in [start, end) visible at at_rev,
         plus the total count (limit applies to the list only).
-        end=None → the single key `start` (ref: index.go Revisions)."""
+        end=None → the single key `start`; end=b"" → open end, every
+        key ≥ start (the \\x00 range sentinel resolves to this;
+        ref: index.go Revisions)."""
         with self._lock:
             if end is None:
                 try:
@@ -69,7 +71,8 @@ class TreeIndex:
                     return [], 0
             revs: List[Revision] = []
             total = 0
-            for key in self._tree.irange(start, end, inclusive=(True, False)):
+            for key in self._tree.irange(start, end if end else None,
+                                         inclusive=(True, False)):
                 ki: KeyIndex = self._tree[key]
                 try:
                     rev, _, _ = ki.get(at_rev)
@@ -104,7 +107,8 @@ class TreeIndex:
         with self._lock:
             keys = (
                 [start] if end is None
-                else list(self._tree.irange(start, end, inclusive=(True, False)))
+                else list(self._tree.irange(start, end if end else None,
+                                            inclusive=(True, False)))
             )
             revs: List[Revision] = []
             for key in keys:

@@ -38,6 +38,10 @@ from .revision import rev_to_bytes
 # reference uses chanBufLen 128 on the watch channel.
 DEFAULT_BUFFER_CAP = 1024
 
+# Interval-tree stand-in for an open-ended watch range (end=b"", the
+# \x00 sentinel): sorts above any practical key.
+WATCH_OPEN_MAX = b"\xff" * 256
+
 
 @dataclass
 class WatchResponse:
@@ -63,7 +67,7 @@ class Watcher:
     def interval(self) -> Interval:
         if self.end is None:
             return point_interval(self.key)
-        return Interval(self.key, self.end)
+        return Interval(self.key, self.end if self.end else WATCH_OPEN_MAX)
 
     def send(self, resp: WatchResponse) -> bool:
         if self.filters:
@@ -236,7 +240,7 @@ class WatchableStore(KVStore):
             chosen, min_rev = self.unsynced.choose_min_rev(
                 max_watchers, cur, compact
             )
-            revs = self.index.range_since(b"", b"\xff" * 32, min_rev)
+            revs = self.index.range_since(b"", b"", min_rev)
             evs = self._events_from_revs(revs)
             for w in chosen:
                 if w.compacted:
@@ -320,6 +324,8 @@ class WatchableStore(KVStore):
     def _match(w: Watcher, ev: Event) -> bool:
         if w.end is None:
             return ev.kv.key == w.key
+        if not w.end:  # open end (the \x00 sentinel)
+            return ev.kv.key >= w.key
         return w.key <= ev.kv.key < w.end
 
     def _events_from_revs(self, revs) -> List[Event]:

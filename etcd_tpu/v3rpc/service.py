@@ -231,6 +231,8 @@ class _Conn(FramedServerConn):
         key = bytes.fromhex(params["key"])
         end_hex = params.get("range_end", "")
         end = bytes.fromhex(end_hex) if end_hex else None
+        if end == b"\x00":
+            end = b""  # open end: every key ≥ key (the \x00 sentinel)
         wid = self.watch_stream.watch(
             key, end, start_rev=params.get("start_revision", 0)
         )

@@ -184,7 +184,7 @@ class TestMirror:
             import threading
 
             # Base copy only.
-            n = sy.mirror_to(dst, max_txns=0)
+            n = sy.mirror_to(dst, base_only=True)
             assert n == 5
             assert dst.get(b"mir/src3").kvs[0].value == b"v3"
             assert dst.get(b"other/key").count == 0

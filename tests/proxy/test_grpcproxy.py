@@ -73,7 +73,9 @@ class TestGrpcProxy:
             c2 = Client([proxy.addr])
             h1 = c1.watch(b"wk")
             h2 = c2.watch(b"wk")
-            # Both watchers share ONE upstream broadcast.
+            # Both watchers share ONE upstream broadcast (join happens
+            # after the create response is on the wire).
+            wait_until(lambda: len(proxy._bcasts) == 1, msg="broadcast join")
             assert len(proxy._bcasts) == 1
             writer = Client([rpc.addr])
             writer.put(b"wk", b"fanout")
