@@ -19,6 +19,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..server import api as sapi
 from .client import Client, ClientError
+from .util import prefix_end as _prefix_end
 
 
 class Session:
@@ -265,4 +266,3 @@ class STMTxn:
         return resp if resp.succeeded else None
 
 
-from .util import prefix_end as _prefix_end  # noqa: E402 — shared helper

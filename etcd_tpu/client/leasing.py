@@ -40,6 +40,8 @@ class LeasingKV:
         self._lock = threading.Lock()
         self._cache: Dict[bytes, Optional[sapi.KeyValue]] = {}
         self._owned: Dict[bytes, int] = {}  # key -> marker create_rev
+        self._acquiring: set = set()  # keys mid-acquisition
+        self._revoked_early: set = set()  # REVOKE seen while acquiring
         self.cache_hits = 0
         self._closed = False
         self._watch = client.watch(self.pfx, prefix_end(self.pfx))
@@ -49,6 +51,7 @@ class LeasingKV:
     def close(self) -> None:
         self._closed = True
         self._watch.cancel()
+        self._watcher.join(timeout=5)
         # Release markers so other clients acquire immediately.
         with self._lock:
             owned = list(self._owned)
@@ -91,15 +94,32 @@ class LeasingKV:
                 sapi.RequestOp(request_range=sapi.RangeRequest(key=key)),
             ],
         )
-        resp = self.c.txn(txn)
-        if resp.succeeded:
-            rr = resp.responses[1].response_range
+        with self._lock:
+            self._acquiring.add(key)
+            self._revoked_early.discard(key)
+        try:
+            resp = self.c.txn(txn)
+            if resp.succeeded:
+                rr = resp.responses[1].response_range
+                with self._lock:
+                    poisoned = key in self._revoked_early
+                    if not poisoned:
+                        self._owned[key] = resp.header.revision
+                        self._cache[key] = rr.kvs[0] if rr.kvs else None
+                if poisoned:
+                    # A REVOKE raced our acquisition: release right away
+                    # so the waiting writer proceeds.
+                    try:
+                        self.c.delete(marker)
+                    except Exception:  # noqa: BLE001 — lease reclaims
+                        pass
+            else:
+                rr = resp.responses[0].response_range
+            return rr
+        finally:
             with self._lock:
-                self._owned[key] = resp.header.revision
-                self._cache[key] = rr.kvs[0] if rr.kvs else None
-        else:
-            rr = resp.responses[0].response_range
-        return rr
+                self._acquiring.discard(key)
+                self._revoked_early.discard(key)
 
     # -- write path ------------------------------------------------------------
 
@@ -129,9 +149,16 @@ class LeasingKV:
                     pr = resp.responses[0].response_put
                     with self._lock:
                         if key in self._owned:
+                            prev = self._cache.get(key)
+                            rev = resp.header.revision
                             self._cache[key] = sapi.KeyValue(
                                 key=key, value=value,
-                                mod_revision=resp.header.revision,
+                                mod_revision=rev,
+                                create_revision=(
+                                    prev.create_revision if prev else rev
+                                ),
+                                version=(prev.version + 1 if prev else 1),
+                                lease=prev.lease if prev else 0,
                             )
                     return pr
                 with self._lock:  # lost ownership mid-flight
@@ -178,6 +205,10 @@ class LeasingKV:
                 key = ev.kv.key[len(self.pfx):]
                 if ev.type == EventType.PUT and ev.kv.value == REVOKE:
                     with self._lock:
+                        if key in self._acquiring:
+                            # Acquisition in flight: poison it so the
+                            # winner releases immediately.
+                            self._revoked_early.add(key)
                         mine = key in self._owned
                         if mine:
                             self._owned.pop(key, None)

@@ -8,6 +8,7 @@ from typing import Optional
 
 from ..server import api as sapi
 from .client import Client, WatchHandle
+from .util import prefix_end as _prefix_end
 
 
 def _prefix_interval(pfx: bytes, key: bytes, end: bytes) -> tuple:
@@ -21,9 +22,6 @@ def _prefix_interval(pfx: bytes, key: bytes, end: bytes) -> tuple:
     else:
         pend = pfx + end
     return pkey, pend
-
-
-from .util import prefix_end as _prefix_end  # noqa: E402 — shared helper
 
 
 class NamespacedClient:

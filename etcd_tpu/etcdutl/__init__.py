@@ -101,21 +101,20 @@ def snapshot_restore(
 
 def _check_snapshot_integrity(snap_file: str) -> None:
     import sqlite3
-    import tempfile
 
-    with tempfile.TemporaryDirectory() as td:
-        tmp = os.path.join(td, "db")
-        shutil.copyfile(snap_file, tmp)
-        conn = sqlite3.connect(tmp)
-        try:
-            rows = conn.execute("PRAGMA integrity_check").fetchall()
-        except sqlite3.DatabaseError as e:
-            raise ValueError(
-                f"snapshot integrity check failed: {e} "
-                f"(use --skip-hash-check to override)"
-            )
-        finally:
-            conn.close()
+    # Read-only immutable open: no copy, no wal/journal side files.
+    conn = sqlite3.connect(
+        f"file:{snap_file}?mode=ro&immutable=1", uri=True
+    )
+    try:
+        rows = conn.execute("PRAGMA integrity_check").fetchall()
+    except sqlite3.DatabaseError as e:
+        raise ValueError(
+            f"snapshot integrity check failed: {e} "
+            f"(use --skip-hash-check to override)"
+        )
+    finally:
+        conn.close()
     if rows != [("ok",)]:
         raise ValueError(
             f"snapshot integrity check failed: {rows!r} "

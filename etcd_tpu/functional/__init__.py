@@ -1,0 +1,18 @@
+"""Functional/chaos harness (ref: tests/functional/ — agent + tester +
+stressers + checkers driven by functional.yaml).
+
+The reference supervises real processes via per-member agents; here
+members are in-proc EtcdServers supervised by `Cluster` (kill =
+stop + recreate on the same data dir, which exercises the same WAL
+replay/snapshot recovery paths), faults ride the network hooks and
+failpoints, and the tester loop is `run_case`.
+"""
+
+from .cluster import Cluster
+from .checker import hash_check, lease_expire_check, linearizable_check
+from .stresser import KVStresser, LeaseStresser
+
+__all__ = [
+    "Cluster", "KVStresser", "LeaseStresser",
+    "hash_check", "lease_expire_check", "linearizable_check",
+]

@@ -1,0 +1,58 @@
+"""Checkers: post-fault invariants
+(ref: tests/functional/tester/checker_kv_hash.go, checker_lease_expire.go,
+checker_no_check.go; cluster consistency = same KV hash at the same
+revision across members)."""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from ..server import EtcdServer
+from ..server.api import RangeRequest
+
+
+def hash_check(servers: List[EtcdServer], timeout: float = 20.0) -> int:
+    """All members converge to the same hash_kv at the same revision
+    (checker_kv_hash.go waits up to 7 rounds). Returns the agreed rev."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            # Pin the comparison at the smallest current revision.
+            rev = min(s.kv.rev() for s in servers)
+            hashes = {s.hash_kv(rev)[0] for s in servers}
+            if len(hashes) == 1:
+                return rev
+            last = hashes
+        except Exception as e:  # noqa: BLE001 — members mid-recovery
+            last = e
+        time.sleep(0.1)
+    raise AssertionError(f"kv hash mismatch after {timeout}s: {last}")
+
+
+def lease_expire_check(server: EtcdServer, lease_ids: List[int],
+                       keys: List[bytes], timeout: float = 30.0) -> None:
+    """Expired leases are gone and their keys deleted
+    (checker_lease_expire.go)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        alive = set(server.lease_leases())
+        if not (alive & set(lease_ids)):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("leases did not expire")
+    for key in keys:
+        rr = server.range(RangeRequest(key=key, serializable=True))
+        assert not rr.kvs, f"leased key {key!r} survived expiry"
+
+
+def linearizable_check(server: EtcdServer, key: bytes,
+                       expect_value: bytes) -> None:
+    """A linearizable read observes the latest committed write."""
+    rr = server.range(RangeRequest(key=key))
+    assert rr.kvs and rr.kvs[0].value == expect_value, (
+        f"linearizable read saw {rr.kvs[0].value if rr.kvs else None!r}, "
+        f"want {expect_value!r}"
+    )

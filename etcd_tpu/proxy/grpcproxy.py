@@ -228,10 +228,11 @@ class _ProxyConn(FramedServerConn):
 
     def __init__(self, proxy: GrpcProxy, sock: socket.socket) -> None:
         self.p = proxy
-        self._wstate = threading.Lock()  # guards _next_wid + _wlocal
+        self._wstate = threading.Lock()  # guards watch bookkeeping below
         self._next_wid = 0
         self._wlocal: Dict[int, Tuple[bytes, Optional[bytes], Any]] = {}
-        self._pending_pumps: Dict[int, Any] = {}  # wid -> handle (start after response)
+        self._ready_wids: set = set()  # create response on the wire
+        self._buffered: Dict[int, list] = {}  # wid -> [(rev, events)]
         import struct as _struct
 
         try:
@@ -244,6 +245,13 @@ class _ProxyConn(FramedServerConn):
         super().__init__(sock, proxy._stopped)
 
     def push_event(self, wid: int, revision: int, events) -> bool:
+        # Until the WatchCreate response is on the wire the client can't
+        # route this wid — buffer instead of dropping (flushed by
+        # after_send).
+        with self._wstate:
+            if wid in self._wlocal and wid not in self._ready_wids:
+                self._buffered.setdefault(wid, []).append((revision, events))
+                return True
         return self.send_frame({
             "stream": wid,
             "event": {
@@ -265,25 +273,27 @@ class _ProxyConn(FramedServerConn):
         self.p._conns.discard(self.sock)
 
     def after_send(self, method: str, params: Dict, result: Any) -> None:
-        # Event delivery starts only AFTER the WatchCreate response
-        # frame is on the wire, or events could beat the watch_id back
-        # to the client and be dropped there (client registers the
-        # handle only once the response returns).
+        # The create response is on the wire: flush anything buffered
+        # while the client couldn't route this wid yet.
         if method != "WatchCreate":
             return
         wid = result.get("watch_id")
-        with self._wstate:
-            pend = self._pending_pumps.pop(wid, None)
-        if pend is None:
-            return
-        kind, payload = pend
-        if kind == "dedicated":
-            threading.Thread(
-                target=self._dedicated_pump, args=(wid, payload), daemon=True
-            ).start()
-        else:  # broadcast join deferred until now
-            key, end = payload
-            self.p.broadcast_join(key, end, self, wid)
+        # Drain-then-mark-ready loop: concurrent pumps keep buffering
+        # until the buffer is empty, so event order is preserved.
+        while True:
+            with self._wstate:
+                pending = self._buffered.pop(wid, [])
+                if not pending:
+                    self._ready_wids.add(wid)
+                    return
+            for revision, events in pending:
+                self.send_frame({
+                    "stream": wid,
+                    "event": {
+                        "revision": revision,
+                        "events": [wire.enc_event(ev) for ev in events],
+                    },
+                })
 
     def dispatch(self, method: str, params: Dict,
                  token: Optional[str] = None) -> Any:
@@ -333,14 +343,16 @@ class _ProxyConn(FramedServerConn):
         if start_rev == 0:
             with self._wstate:
                 self._wlocal[wid] = (key, end, None)
-                self._pending_pumps[wid] = ("broadcast", (key, end))
+            # Join NOW — no event gap; deliveries buffer until the
+            # create response frame goes out (push_event).
+            self.p.broadcast_join(key, end, self, wid)
         else:
-            # Historical watch: dedicated upstream stream; the pump
-            # starts in after_send (response frame must go first).
             h = self.p.client.watch(key, end, start_rev=start_rev)
             with self._wstate:
                 self._wlocal[wid] = (key, end, h)
-                self._pending_pumps[wid] = ("dedicated", h)
+            threading.Thread(
+                target=self._dedicated_pump, args=(wid, h), daemon=True
+            ).start()
         return {"watch_id": wid, "revision": 0}
 
     def _dedicated_pump(self, wid: int, h) -> None:
@@ -355,7 +367,8 @@ class _ProxyConn(FramedServerConn):
     def _cancel_watch(self, wid: int) -> None:
         with self._wstate:
             ent = self._wlocal.pop(wid, None)
-            self._pending_pumps.pop(wid, None)
+            self._ready_wids.discard(wid)
+            self._buffered.pop(wid, None)
         if ent is None:
             return
         key, end, dedicated = ent

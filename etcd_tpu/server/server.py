@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..auth.store import AuthInfo, AuthStore
 from ..auth.simple_token import SimpleTokenProvider
 from ..lease.lessor import Lessor, LeaseItem, NoLease
+from ..pkg import failpoint
 from ..pkg.idutil import Generator
 from ..pkg.schedule import FIFOScheduler
 from ..pkg.wait import Wait, WaitTime
@@ -211,9 +212,12 @@ class EtcdServer:
             self.compactor.run()
 
         self.network.register(self.id, self._receive_message)
+        self._ready_thread = threading.Thread(
+            target=self._ready_loop, daemon=True, name=f"ready-{self.id}"
+        )
         self._threads = [
             threading.Thread(target=self._tick_loop, daemon=True),
-            threading.Thread(target=self._ready_loop, daemon=True),
+            self._ready_thread,
             threading.Thread(target=self._linearizable_read_loop, daemon=True),
             threading.Thread(target=self._expired_lease_loop, daemon=True),
         ]
@@ -347,17 +351,26 @@ class EtcdServer:
             if islead:
                 # Leader parallel-send: before fsync (raft thesis 10.2.1,
                 # etcdserver/raft.go:218-224).
+                failpoint.fp("raftBeforeLeaderSend")
                 self.network.send(self.id, self._process_messages(rd.messages))
             if not is_empty_snap(rd.snapshot):
+                failpoint.fp("raftBeforeSaveSnap")
                 self.storage.save_snap(rd.snapshot)
+                failpoint.fp("raftAfterSaveSnap")
+            failpoint.fp("raftBeforeSave")
             self.wal.save(rd.hard_state, rd.entries, rd.must_sync)
+            failpoint.fp("raftAfterSave")
             if not is_empty_snap(rd.snapshot):
+                failpoint.fp("raftBeforeApplySnap")
                 self.raft_storage.apply_snapshot(rd.snapshot)
+                failpoint.fp("raftAfterApplySnap")
             persisted.set()
             if rd.entries:
                 self.raft_storage.append(rd.entries)
             if not islead:
+                failpoint.fp("raftBeforeFollowerSend")
                 self.network.send(self.id, self._process_messages(rd.messages))
+            failpoint.fp("raftBeforeAdvance")
             self.node.advance()
 
     def _process_messages(self, msgs: List[Message]) -> List[Message]:

@@ -82,7 +82,15 @@ class KVStore:
                 kv.key, rev, Revision(kv.create_revision, 0), kv.version
             )
             if self.lessor is not None and kv.lease:
-                self.lessor.attach_restored(kv.lease, kv.key)
+                # Reattach (restore path, kvstore.go:393-402); the lease
+                # may be gone if an old revision's lease was revoked —
+                # the reference logs and continues.
+                from ...lease.lessor import LeaseNotFoundError
+
+                try:
+                    self.lessor.attach(kv.lease, kv.key)
+                except LeaseNotFoundError:
+                    pass
         sched = rt.get(bk.META, SCHEDULED_COMPACT_KEY)
         if sched is not None:
             srev = struct.unpack("<q", sched)[0]

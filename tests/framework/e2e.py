@@ -1,0 +1,154 @@
+"""E2E framework: spawn REAL processes — members via `python -m
+etcd_tpu`, commands via `python -m etcd_tpu.etcdctl` / etcdutl
+(ref: tests/framework/e2e/etcd_process.go, etcd_spawn.go, etcdctl.go;
+the reference drives compiled binaries through pkg/expect ptys)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _env() -> Dict[str, str]:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class EtcdProcess:
+    """One member as a real OS process (etcd_process.go)."""
+
+    def __init__(self, name: str, data_dir: str, peer_port: int,
+                 client_port: int, metrics_port: int,
+                 initial_cluster: str, extra: Optional[List[str]] = None):
+        self.name = name
+        self.data_dir = data_dir
+        self.peer_port = peer_port
+        self.client_port = client_port
+        self.metrics_port = metrics_port
+        self.initial_cluster = initial_cluster
+        self.extra = extra or []
+        self.proc: Optional[subprocess.Popen] = None
+
+    def start(self) -> None:
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "etcd_tpu",
+             "--name", self.name,
+             "--data-dir", self.data_dir,
+             "--listen-peer-urls", f"http://127.0.0.1:{self.peer_port}",
+             "--listen-client-urls", f"http://127.0.0.1:{self.client_port}",
+             "--listen-metrics-urls", f"http://127.0.0.1:{self.metrics_port}",
+             "--initial-cluster", self.initial_cluster,
+             "--heartbeat-interval", "20", "--election-timeout", "200",
+             *self.extra],
+            env=_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True,
+        )
+
+    def wait_ready(self, timeout: float = 60.0) -> None:
+        """Member serves client health (etcd_process.go waitReady)."""
+        import json
+        import urllib.request
+
+        deadline = time.monotonic() + timeout
+        url = f"http://127.0.0.1:{self.metrics_port}/health?serializable=true"
+        while time.monotonic() < deadline:
+            if self.proc is not None and self.proc.poll() is not None:
+                raise AssertionError(
+                    f"{self.name} exited early rc={self.proc.returncode}"
+                )
+            try:
+                with urllib.request.urlopen(url, timeout=2) as r:
+                    if json.loads(r.read())["health"] == "true":
+                        return
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+        raise AssertionError(f"{self.name} never became healthy")
+
+    def stop(self, sig: int = signal.SIGTERM, timeout: float = 15.0) -> int:
+        if self.proc is None:
+            return 0
+        if self.proc.poll() is None:
+            self.proc.send_signal(sig)
+        try:
+            rc = self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            rc = self.proc.wait(timeout=timeout)
+        if self.proc.stdout:
+            self.proc.stdout.close()
+        self.proc = None
+        return rc
+
+    def kill9(self) -> int:
+        return self.stop(sig=signal.SIGKILL)
+
+
+class E2ECluster:
+    def __init__(self, data_root: str, n: int = 3) -> None:
+        ports = free_ports(3 * n)
+        names = [f"e{i}" for i in range(n)]
+        initial = ",".join(
+            f"{nm}=http://127.0.0.1:{ports[3 * i]}"
+            for i, nm in enumerate(names)
+        )
+        self.procs = [
+            EtcdProcess(
+                nm, os.path.join(data_root, nm),
+                ports[3 * i], ports[3 * i + 1], ports[3 * i + 2], initial,
+            )
+            for i, nm in enumerate(names)
+        ]
+
+    def start(self) -> None:
+        for p in self.procs:
+            p.start()
+        for p in self.procs:
+            p.wait_ready()
+
+    def endpoints(self) -> str:
+        return ",".join(f"127.0.0.1:{p.client_port}" for p in self.procs)
+
+    def close(self) -> None:
+        for p in self.procs:
+            p.stop()
+
+
+def etcdctl(endpoints: str, *args: str, stdin: Optional[str] = None,
+            timeout: float = 60.0) -> Tuple[int, str, str]:
+    """ref: e2e/etcdctl.go ctlV3 — run the real CLI process."""
+    r = subprocess.run(
+        [sys.executable, "-m", "etcd_tpu.etcdctl",
+         "--endpoints", endpoints, *args],
+        env=_env(), capture_output=True, text=True, input=stdin,
+        timeout=timeout,
+    )
+    return r.returncode, r.stdout, r.stderr
+
+
+def etcdutl(*args: str, timeout: float = 60.0) -> Tuple[int, str, str]:
+    r = subprocess.run(
+        [sys.executable, "-m", "etcd_tpu.etcdutl", *args],
+        env=_env(), capture_output=True, text=True, timeout=timeout,
+    )
+    return r.returncode, r.stdout, r.stderr

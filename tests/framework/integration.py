@@ -1,0 +1,132 @@
+"""Integration framework: in-proc members with real RPC listeners and
+a fault-injectable bridge on client connections
+(ref: tests/framework/integration/cluster.go ClusterConfig/Cluster,
+bridge.go — the bridge interposes on client conns to drop/blackhole/
+reset them without touching the member)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from etcd_tpu.client.client import Client
+from etcd_tpu.pkg.proxy import ProxyServer
+from etcd_tpu.raftexample.transport import InProcNetwork
+from etcd_tpu.server import EtcdServer, ServerConfig
+from etcd_tpu.v3rpc.service import V3RPCServer
+
+
+class Member:
+    def __init__(self, cluster: "IntegrationCluster", nid: int) -> None:
+        self.cluster = cluster
+        self.id = nid
+        self.server: Optional[EtcdServer] = None
+        self.rpc: Optional[V3RPCServer] = None
+        self.bridge: Optional[ProxyServer] = None
+
+    def start(self) -> None:
+        c = self.cluster
+        self.server = EtcdServer(
+            ServerConfig(
+                member_id=self.id,
+                peers=c.peers,
+                data_dir=c.data_dir,
+                network=c.net,
+                tick_interval=c.tick_interval,
+                request_timeout=10.0,
+                **c.cfg_kw,
+            )
+        )
+        self.rpc = V3RPCServer(self.server, bind=("127.0.0.1", 0))
+        # The bridge fronts the RPC listener (cluster.go:786 addBridge).
+        self.bridge = ProxyServer(("127.0.0.1", 0), self.rpc.addr)
+
+    def client_addr(self, via_bridge: bool = True):
+        return self.bridge.addr if via_bridge else self.rpc.addr
+
+    def client(self, via_bridge: bool = True) -> Client:
+        return Client([self.client_addr(via_bridge)])
+
+    def terminate(self) -> None:
+        if self.bridge is not None:
+            self.bridge.stop()
+            self.bridge = None
+        if self.rpc is not None:
+            self.rpc.stop()
+            self.rpc = None
+        if self.server is not None:
+            self.server.stop()
+            self.cluster.net.unregister(self.id)
+            self.server = None
+
+    def restart(self) -> None:
+        assert self.server is None
+        self.cluster.net.heal(self.id)
+        self.start()
+
+
+class IntegrationCluster:
+    """ref: integration.Cluster (cluster.go:176)."""
+
+    def __init__(self, data_dir: str, n: int = 3,
+                 tick_interval: float = 0.01, **cfg_kw) -> None:
+        self.data_dir = data_dir
+        self.peers = list(range(1, n + 1))
+        self.tick_interval = tick_interval
+        self.cfg_kw = cfg_kw
+        self.net = InProcNetwork()
+        self.members: Dict[int, Member] = {}
+        for nid in self.peers:
+            m = Member(self, nid)
+            m.start()
+            self.members[nid] = m
+
+    def alive_servers(self) -> List[EtcdServer]:
+        return [
+            m.server for m in self.members.values() if m.server is not None
+        ]
+
+    def wait_leader(self, timeout: float = 20.0) -> Member:
+        """ref: cluster.go:404 WaitLeader."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for m in self.members.values():
+                if m.server is not None and m.server.is_leader():
+                    return m
+            time.sleep(0.02)
+        raise AssertionError("no leader")
+
+    def close(self) -> None:
+        for m in self.members.values():
+            m.terminate()
+        self.net.stop()
+
+
+class ThreadLeakGuard:
+    """Goroutine-leak analog (ref: client/pkg/testutil/leak.go
+    BeforeTest/AfterTest): snapshot live threads, assert the population
+    returns to baseline after the test body (daemon pollers get a grace
+    window to drain)."""
+
+    def __init__(self, grace: float = 10.0, slack: int = 2) -> None:
+        self.grace = grace
+        self.slack = slack
+
+    def __enter__(self) -> "ThreadLeakGuard":
+        self.before = threading.active_count()
+        return self
+
+    def __exit__(self, exc_type, *rest) -> bool:
+        if exc_type is not None:
+            return False
+        deadline = time.monotonic() + self.grace
+        while time.monotonic() < deadline:
+            if threading.active_count() <= self.before + self.slack:
+                return False
+            time.sleep(0.1)
+        leaked = threading.active_count() - self.before
+        names = sorted(t.name for t in threading.enumerate())
+        raise AssertionError(
+            f"{leaked} threads leaked beyond slack {self.slack}: {names}"
+        )
