@@ -70,6 +70,10 @@ class Config:
     initial_cluster: str = ""  # "name1=http://h:p,name2=..."
     initial_cluster_state: str = CLUSTER_STATE_NEW
     initial_cluster_token: str = "etcd-cluster"
+    # v3 discovery bootstrap (ref: api/v3discovery): when set and no
+    # initial-cluster is given, the roster comes from this cluster.
+    discovery_endpoints: str = ""  # "host:port,host:port"
+    discovery_token: str = ""
     # Raft timing (milliseconds, ref: config.go TickMs/ElectionMs).
     heartbeat_interval: int = 100
     election_timeout: int = 1000

@@ -80,6 +80,20 @@ def start_etcd(cfg: Config) -> Etcd:
             raise RuntimeError(f"ETCD_VERIFY failed for {cfg.data_dir}")
     e = Etcd(cfg)
 
+    if cfg.discovery_endpoints and cfg.discovery_token and not cfg.initial_cluster:
+        # v3 discovery: register with the discovery cluster and wait
+        # for the roster (bootstrap.go discovery path).
+        from ..discovery import join_cluster
+
+        eps = []
+        for part in cfg.discovery_endpoints.split(","):
+            host, port = part.strip().rsplit(":", 1)
+            eps.append((host, int(port)))
+        cfg.initial_cluster = join_cluster(
+            eps, cfg.discovery_token, cfg.name,
+            cfg.effective_advertise_peer_urls(),
+        )
+
     cluster = cfg.initial_cluster_map()  # name -> peer urls
     ids: Dict[str, int] = {
         nm: member_id_from_urls(urls, cfg.initial_cluster_token)

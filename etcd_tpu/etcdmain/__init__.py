@@ -43,6 +43,8 @@ def _add_member_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--auto-compaction-mode", default="")
     p.add_argument("--auto-compaction-retention", default="0")
     p.add_argument("--auth-token", default=cfg.auth_token)
+    p.add_argument("--discovery-endpoints", default="")
+    p.add_argument("--discovery-token", default="")
     p.add_argument("--log-level", default=cfg.log_level)
     p.add_argument("--enable-pprof", action="store_true")
     p.add_argument("--config-file", default="")
@@ -55,7 +57,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
     for f in cfg.__dataclass_fields__:
         if hasattr(args, f):
             setattr(cfg, f, getattr(args, f))
-    if not cfg.initial_cluster:
+    if not cfg.initial_cluster and not cfg.discovery_token:
         cfg.initial_cluster = (
             f"{cfg.name}={cfg.effective_advertise_peer_urls()}"
         )

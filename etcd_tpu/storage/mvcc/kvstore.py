@@ -68,6 +68,12 @@ class KVStore:
         if fin is not None:
             self.compact_rev = struct.unpack("<q", fin)[0]
         rows = rt.range(bk.KEY, b"", b"\xff" * 32)
+        # Lease attachments reflect only each key's LATEST state: later
+        # revisions override, tombstones clear (ref: kvstore.go restore
+        # builds keyToLease the same way). Attaching per historical row
+        # would resurrect stale attachments and delete live keys on
+        # lease expiry.
+        key_lease: Dict[bytes, int] = {}
         for rkey, rval in rows:
             rev = bytes_to_rev(rkey)
             self.current_rev = rev.main
@@ -76,21 +82,23 @@ class KVStore:
                     self.index.tombstone(rval, rev)
                 except RevisionNotFound:
                     pass  # creation compacted away; tombstone is stale
+                key_lease.pop(rval, None)
                 continue
             kv = KeyValue.unmarshal(rval)
             self.index.restore_key(
                 kv.key, rev, Revision(kv.create_revision, 0), kv.version
             )
-            if self.lessor is not None and kv.lease:
-                # Reattach (restore path, kvstore.go:393-402); the lease
-                # may be gone if an old revision's lease was revoked —
-                # the reference logs and continues.
-                from ...lease.lessor import LeaseNotFoundError
+            key_lease[kv.key] = kv.lease
+        if self.lessor is not None:
+            from ...lease.lessor import LeaseNotFoundError
 
+            for key, lease_id in key_lease.items():
+                if not lease_id:
+                    continue
                 try:
-                    self.lessor.attach(kv.lease, kv.key)
+                    self.lessor.attach(lease_id, key)
                 except LeaseNotFoundError:
-                    pass
+                    pass  # revoked after the final put; nothing to attach
         sched = rt.get(bk.META, SCHEDULED_COMPACT_KEY)
         if sched is not None:
             srev = struct.unpack("<q", sched)[0]

@@ -1,0 +1,2 @@
+"""Operational tooling (ref: tools/ — benchmark, etcd-dump-db,
+etcd-dump-logs, etcd-dump-metrics, local-tester)."""

@@ -1,0 +1,144 @@
+"""Offline backend inspector (ref: tools/etcd-dump-db — list-bucket,
+iterate-bucket, hash over a stopped member's db file)."""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import sqlite3
+import sys
+from typing import List, Optional
+
+
+TABLE_PREFIX = "bucket_"  # storage/backend.py Bucket.table naming
+
+
+def _tables(conn: sqlite3.Connection) -> List[str]:
+    return [
+        r[0] for r in conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' ORDER BY name"
+        )
+        if r[0].startswith(TABLE_PREFIX)
+    ]
+
+
+def _bucket_name(table: str) -> str:
+    return table[len(TABLE_PREFIX):]
+
+
+def _open_ro(db_path: str) -> sqlite3.Connection:
+    # mode=ro (not immutable): the backend runs journal_mode=WAL, so a
+    # not-yet-checkpointed -wal sidecar must be consulted for reads.
+    return sqlite3.connect(f"file:{db_path}?mode=ro", uri=True)
+
+
+def list_bucket(db_path: str) -> int:
+    conn = _open_ro(db_path)
+    try:
+        for t in _tables(conn):
+            print(_bucket_name(t))
+    finally:
+        conn.close()
+    return 0
+
+
+def iterate_bucket(db_path: str, bucket: str, limit: int = 0,
+                   decode: bool = False) -> int:
+    conn = _open_ro(db_path)
+    table = TABLE_PREFIX + bucket
+    try:
+        if table not in _tables(conn):
+            print(f"bucket {bucket!r} not found", file=sys.stderr)
+            return 1
+        n = 0
+        for k, v in conn.execute(f"SELECT k, v FROM {table} ORDER BY k"):
+            if decode and bucket == "key":
+                from ..storage.mvcc.kv import KeyValue
+                from ..storage.mvcc.revision import (
+                    bytes_to_rev, is_tombstone_key,
+                )
+
+                rev = bytes_to_rev(k)
+                if is_tombstone_key(k):
+                    print(f"rev={{{rev.main}/{rev.sub}}} TOMBSTONE "
+                          f"key={v!r}")
+                else:
+                    kv = KeyValue.unmarshal(v)
+                    print(
+                        f"rev={{{rev.main}/{rev.sub}}} key={kv.key!r} | "
+                        f"val={kv.value!r} | created={kv.create_revision} "
+                        f"| mod={kv.mod_revision} | ver={kv.version} "
+                        f"| lease={kv.lease:x}"
+                    )
+            else:
+                print(f"key={k.hex()} | value={v.hex()}")
+            n += 1
+            if limit and n >= limit:
+                break
+    finally:
+        conn.close()
+    return 0
+
+
+def hash_db(db_path: str) -> int:
+    h = hashlib.sha256()
+    conn = _open_ro(db_path)
+    try:
+        for t in _tables(conn):
+            h.update(_bucket_name(t).encode())
+            for k, v in conn.execute(f"SELECT k, v FROM {t} ORDER BY k"):
+                h.update(k)
+                h.update(v)
+    finally:
+        conn.close()
+    print(f"db path: {db_path}")
+    print(f"Hash: {int.from_bytes(h.digest()[:4], 'big'):x}")
+    return 0
+
+
+def _resolve_db(path: str) -> str:
+    """Accept a data dir, member dir, or db file."""
+    if os.path.isfile(path):
+        return path
+    direct = os.path.join(path, "db")
+    if os.path.isfile(direct):
+        return direct
+    for entry in sorted(os.listdir(path)):
+        cand = os.path.join(path, entry, "db")
+        if entry.startswith("member-") and os.path.isfile(cand):
+            return cand
+    raise FileNotFoundError(f"no db under {path}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="etcd-dump-db")
+    sub = p.add_subparsers(dest="cmd")
+    x = sub.add_parser("list-bucket")
+    x.add_argument("path")
+    x = sub.add_parser("iterate-bucket")
+    x.add_argument("path")
+    x.add_argument("bucket")
+    x.add_argument("--limit", type=int, default=0)
+    x.add_argument("--decode", action="store_true")
+    x = sub.add_parser("hash")
+    x.add_argument("path")
+    args = p.parse_args(argv)
+    try:
+        if args.cmd == "list-bucket":
+            return list_bucket(_resolve_db(args.path))
+        if args.cmd == "iterate-bucket":
+            return iterate_bucket(
+                _resolve_db(args.path), args.bucket, args.limit, args.decode
+            )
+        if args.cmd == "hash":
+            return hash_db(_resolve_db(args.path))
+    except FileNotFoundError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""Offline WAL inspector (ref: tools/etcd-dump-logs — dump entries
+with decoded request payloads, HardState records, snapshot markers)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from ..native import walog as nwalog
+from ..storage import wal as walmod
+
+
+def _resolve_wal(path: str) -> str:
+    if os.path.isdir(os.path.join(path, "wal")):
+        return os.path.join(path, "wal")
+    if os.path.basename(path) == "wal" and os.path.isdir(path):
+        return path
+    for entry in sorted(os.listdir(path)):
+        cand = os.path.join(path, entry, "wal")
+        if entry.startswith("member-") and os.path.isdir(cand):
+            return cand
+    raise FileNotFoundError(f"no wal dir under {path}")
+
+
+def _describe_entry(term: int, index: int, etype: int, data: bytes) -> str:
+    from ..raft.types import EntryType
+
+    if etype in (int(EntryType.EntryConfChange), int(EntryType.EntryConfChangeV2)):
+        kind = ("conf-change" if etype == int(EntryType.EntryConfChange)
+                else "conf-change-v2")
+        return f"{term}\t{index}\t{kind}\t{len(data)}B"
+    if not data:
+        return f"{term}\t{index}\tnorm\t<empty (term start)>"
+    try:
+        from ..server.api import InternalRaftRequest
+
+        req = InternalRaftRequest.unmarshal(data)
+        detail = f"id={req.id} op={req.op}"
+        r = req.req
+        key = getattr(r, "key", None)
+        if key is not None:
+            detail += f" key={key!r}"
+        return f"{term}\t{index}\tnorm\t{detail}"
+    except Exception:  # noqa: BLE001 — not an InternalRaftRequest
+        return f"{term}\t{index}\tnorm\t{len(data)}B (opaque)"
+
+
+def dump(path: str, start_index: int = 0, limit: int = 0) -> int:
+    wal_dir = _resolve_wal(path)
+    print(f"WAL entries from {wal_dir}:")
+    print("term\tindex\ttype\tdata")
+    n = 0
+    for rtype, data, _seq, _meta in nwalog.read_all(wal_dir, repair=False):
+        if rtype == walmod.REC_ENTRY:
+            hdr = walmod._ENTRY_HDR
+            term, index, etype = hdr.unpack(data[: hdr.size])
+            if index < start_index:
+                continue
+            print(_describe_entry(term, index, etype, data[hdr.size:]))
+            n += 1
+            if limit and n >= limit:
+                break
+        elif rtype == walmod.REC_STATE:
+            term, vote, commit = walmod._STATE.unpack(data)
+            print(f"-\t-\tstate\tterm={term} vote={vote:x} commit={commit}")
+        elif rtype == walmod.REC_SNAPSHOT:
+            index, term = walmod._SNAP.unpack(data)
+            print(f"{term}\t{index}\tsnapshot\t-")
+        elif rtype == walmod.REC_METADATA:
+            print(f"-\t-\tmetadata\t{data.hex()}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="etcd-dump-logs")
+    p.add_argument("path", help="data dir, member dir, or wal dir")
+    p.add_argument("--start-index", type=int, default=0)
+    p.add_argument("--limit", type=int, default=0)
+    args = p.parse_args(argv)
+    try:
+        return dump(args.path, args.start_index, args.limit)
+    except FileNotFoundError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

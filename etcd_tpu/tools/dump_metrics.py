@@ -1,0 +1,56 @@
+"""Metrics dumper (ref: tools/etcd-dump-metrics — spawn or scrape a
+member and print its metric names/values sorted)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import urllib.request
+from typing import List, Optional
+
+
+def dump_url(url: str, names_only: bool = False) -> int:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        text = r.read().decode()
+    for line in sorted(text.splitlines()):
+        if line.startswith("#"):
+            continue
+        print(line.split(" ")[0] if names_only else line)
+    return 0
+
+
+def dump_local(names_only: bool = False) -> int:
+    """Every metric this build registers (spawns nothing: importing the
+    server modules registers the full set)."""
+    import etcd_tpu.server.metrics  # noqa: F401
+    import etcd_tpu.server.server  # noqa: F401
+    import etcd_tpu.storage.metrics  # noqa: F401
+    import etcd_tpu.storage.mvcc.metrics  # noqa: F401
+    import etcd_tpu.transport.metrics  # noqa: F401
+    from etcd_tpu.pkg import metrics as pmet
+
+    for line in pmet.DEFAULT.expose().splitlines():
+        if line.startswith("#"):
+            continue
+        print(line.split(" ")[0] if names_only else line)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="etcd-dump-metrics")
+    p.add_argument("--addr", default="",
+                   help="scrape http://addr/metrics instead of local defaults")
+    p.add_argument("--names-only", action="store_true")
+    args = p.parse_args(argv)
+    if args.addr:
+        url = args.addr
+        if not url.startswith("http"):
+            url = f"http://{url}"
+        if not url.endswith("/metrics"):
+            url += "/metrics"
+        return dump_url(url, args.names_only)
+    return dump_local(args.names_only)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

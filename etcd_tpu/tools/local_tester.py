@@ -1,0 +1,123 @@
+"""Local cluster runner with optional fault loop (ref:
+tools/local-tester — Procfile cluster + network/process faults for
+manual soak testing).
+
+`python -m etcd_tpu.tools.local_tester --members 3 --data-root /tmp/lc`
+boots a real-process cluster, prints endpoints, and (with --faults)
+randomly SIGSTOPs/SIGCONTs or SIGKILLs+restarts members until ^C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class _Member:
+    def __init__(self, name: str, data_dir: str, peer: int, client: int,
+                 metrics: int, initial: str) -> None:
+        self.name = name
+        self.data_dir = data_dir
+        self.peer, self.client, self.metrics = peer, client, metrics
+        self.initial = initial
+        self.proc: Optional[subprocess.Popen] = None
+
+    def start(self) -> None:
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "etcd_tpu",
+             "--name", self.name, "--data-dir", self.data_dir,
+             "--listen-peer-urls", f"http://127.0.0.1:{self.peer}",
+             "--listen-client-urls", f"http://127.0.0.1:{self.client}",
+             "--listen-metrics-urls", f"http://127.0.0.1:{self.metrics}",
+             "--initial-cluster", self.initial,
+             "--heartbeat-interval", "50", "--election-timeout", "500"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="local-tester")
+    p.add_argument("--members", type=int, default=3)
+    p.add_argument("--data-root", default="/tmp/etcd_tpu-local")
+    p.add_argument("--faults", action="store_true")
+    p.add_argument("--fault-interval", type=float, default=10.0)
+    p.add_argument("--rounds", type=int, default=0, help="0 = until ^C")
+    args = p.parse_args(argv)
+
+    ports = _free_ports(3 * args.members)
+    names = [f"m{i}" for i in range(args.members)]
+    initial = ",".join(
+        f"{nm}=http://127.0.0.1:{ports[3 * i]}" for i, nm in enumerate(names)
+    )
+    members = [
+        _Member(nm, os.path.join(args.data_root, nm), ports[3 * i],
+                ports[3 * i + 1], ports[3 * i + 2], initial)
+        for i, nm in enumerate(names)
+    ]
+    for m in members:
+        m.start()
+    print("client endpoints:",
+          ",".join(f"127.0.0.1:{m.client}" for m in members), flush=True)
+    print("metrics:",
+          ",".join(f"127.0.0.1:{m.metrics}" for m in members), flush=True)
+
+    rng = random.Random()
+    rounds = 0
+    try:
+        while True:
+            time.sleep(args.fault_interval if args.faults else 1.0)
+            if not args.faults:
+                continue
+            m = rng.choice(members)
+            fault = rng.choice(["pause", "kill"])
+            if fault == "pause" and m.proc and m.proc.poll() is None:
+                print(f"[fault] SIGSTOP {m.name}", flush=True)
+                m.proc.send_signal(signal.SIGSTOP)
+                time.sleep(rng.uniform(1, args.fault_interval))
+                m.proc.send_signal(signal.SIGCONT)
+                print(f"[fault] SIGCONT {m.name}", flush=True)
+            elif fault == "kill":
+                print(f"[fault] SIGKILL + restart {m.name}", flush=True)
+                if m.proc and m.proc.poll() is None:
+                    m.proc.kill()
+                    m.proc.wait(timeout=15)
+                m.start()
+            rounds += 1
+            if args.rounds and rounds >= args.rounds:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for m in members:
+            if m.proc and m.proc.poll() is None:
+                m.proc.terminate()
+        for m in members:
+            if m.proc:
+                try:
+                    m.proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    m.proc.kill()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""v3 discovery bootstrap (ref: api/v3discovery/discovery.go flows)."""
+
+import threading
+
+import pytest
+
+from etcd_tpu.discovery import DiscoveryError, join_cluster, setup_token
+from etcd_tpu.raftexample.transport import InProcNetwork
+from etcd_tpu.server import EtcdServer, ServerConfig
+from etcd_tpu.v3rpc.service import V3RPCServer
+
+from .test_etcdserver import wait_until
+
+
+@pytest.fixture()
+def discovery_cluster(tmp_path):
+    net = InProcNetwork()
+    srv = EtcdServer(
+        ServerConfig(
+            member_id=1, peers=[1], data_dir=str(tmp_path / "disc"),
+            network=net, tick_interval=0.01,
+        )
+    )
+    rpc = V3RPCServer(srv, bind=("127.0.0.1", 0))
+    wait_until(lambda: srv.is_leader(), msg="discovery leader")
+    yield [rpc.addr]
+    rpc.stop()
+    srv.stop()
+
+
+class TestDiscovery:
+    def test_roster_assembly(self, discovery_cluster):
+        eps = discovery_cluster
+        setup_token(eps, "tok1", size=3)
+        results = {}
+
+        def join(name, url):
+            results[name] = join_cluster(eps, "tok1", name, url, timeout=20)
+
+        threads = [
+            threading.Thread(target=join, args=(f"n{i}", f"http://h{i}:238{i}"))
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 3
+        expect = "n0=http://h0:2380,n1=http://h1:2381,n2=http://h2:2382"
+        assert all(v == expect for v in results.values())
+
+    def test_unset_token_rejected(self, discovery_cluster):
+        with pytest.raises(DiscoveryError, match="not set up"):
+            join_cluster(discovery_cluster, "missing", "x", "http://x:1",
+                         timeout=5)
+
+    def test_full_cluster_rejects_latecomer(self, discovery_cluster):
+        eps = discovery_cluster
+        setup_token(eps, "tok2", size=1)
+        first = join_cluster(eps, "tok2", "a", "http://a:2380", timeout=10)
+        assert first == "a=http://a:2380"
+        with pytest.raises(DiscoveryError, match="full"):
+            join_cluster(eps, "tok2", "b", "http://b:2380", timeout=10)
+
+    def test_rejoin_keeps_slot(self, discovery_cluster):
+        eps = discovery_cluster
+        setup_token(eps, "tok3", size=1)
+        a1 = join_cluster(eps, "tok3", "a", "http://a:2380", timeout=10)
+        a2 = join_cluster(eps, "tok3", "a", "http://ignored:9", timeout=10)
+        assert a1 == a2 == "a=http://a:2380"

@@ -1,0 +1,139 @@
+"""v2 store semantics (ref: api/v2store/store_test.go shapes)."""
+
+import time
+
+import pytest
+
+from etcd_tpu.v2store import (
+    EcodeDirNotEmpty, EcodeKeyNotFound, EcodeNodeExist, EcodeNotFile,
+    EcodeTestFailed, V2Error, V2Store,
+)
+
+
+class TestBasics:
+    def test_set_get(self):
+        s = V2Store()
+        ev = s.set("/foo", value="bar")
+        assert ev.action == "set"
+        assert ev.node.value == "bar"
+        got = s.get("/foo")
+        assert got.node.value == "bar"
+        assert got.node.modified_index == ev.node.modified_index
+
+    def test_get_missing(self):
+        s = V2Store()
+        with pytest.raises(V2Error) as e:
+            s.get("/nope")
+        assert e.value.code == EcodeKeyNotFound
+
+    def test_create_fails_on_existing(self):
+        s = V2Store()
+        s.create("/c", value="1")
+        with pytest.raises(V2Error) as e:
+            s.create("/c", value="2")
+        assert e.value.code == EcodeNodeExist
+
+    def test_update_requires_existing(self):
+        s = V2Store()
+        with pytest.raises(V2Error):
+            s.update("/u", value="x")
+        s.set("/u", value="x")
+        ev = s.update("/u", value="y")
+        assert ev.action == "update"
+        assert ev.prev_node.value == "x"
+
+    def test_dirs_and_recursive_sorted_get(self):
+        s = V2Store()
+        s.set("/d/b", value="2")
+        s.set("/d/a", value="1")
+        s.set("/d/sub/c", value="3")
+        ev = s.get("/d", recursive=True, sorted_=True)
+        assert ev.node.dir
+        keys = [n.key for n in ev.node.nodes]
+        assert keys == ["/d/a", "/d/b", "/d/sub"]
+        sub = ev.node.nodes[2]
+        assert sub.nodes[0].key == "/d/sub/c"
+
+    def test_in_order_unique_keys(self):
+        s = V2Store()
+        e1 = s.create("/q", dir_=True)
+        k1 = s.create("/q", unique=True, value="a").node.key
+        k2 = s.create("/q", unique=True, value="b").node.key
+        assert k1 < k2  # POST ordering by index
+
+    def test_delete_dir_semantics(self):
+        s = V2Store()
+        s.set("/dd/x", value="1")
+        with pytest.raises(V2Error) as e:
+            s.delete("/dd", dir_=True)  # non-empty, not recursive
+        assert e.value.code == EcodeDirNotEmpty
+        s.delete("/dd", recursive=True)
+        with pytest.raises(V2Error):
+            s.get("/dd")
+
+    def test_cas_cad(self):
+        s = V2Store()
+        s.set("/k", value="v1")
+        with pytest.raises(V2Error) as e:
+            s.compare_and_swap("/k", "wrong", 0, "v2")
+        assert e.value.code == EcodeTestFailed
+        ev = s.compare_and_swap("/k", "v1", 0, "v2")
+        assert ev.node.value == "v2"
+        with pytest.raises(V2Error):
+            s.compare_and_delete("/k", "v1", 0)
+        s.compare_and_delete("/k", "v2", 0)
+        with pytest.raises(V2Error):
+            s.get("/k")
+
+    def test_not_file_on_dir_ops(self):
+        s = V2Store()
+        s.set("/dir/leaf", value="x")
+        with pytest.raises(V2Error) as e:
+            s.compare_and_swap("/dir", "a", 0, "b")
+        assert e.value.code == EcodeNotFile
+
+
+class TestTTL:
+    def test_expiry(self):
+        s = V2Store()
+        s.set("/t", value="x", ttl=0.05)
+        assert s.get("/t").node.ttl >= 0
+        time.sleep(0.08)
+        with pytest.raises(V2Error) as e:
+            s.get("/t")
+        assert e.value.code == EcodeKeyNotFound
+
+    def test_update_refreshes_ttl(self):
+        s = V2Store()
+        s.set("/t2", value="x", ttl=0.05)
+        s.update("/t2", value="x", ttl=10)
+        time.sleep(0.08)
+        assert s.get("/t2").node.value == "x"
+
+
+class TestWatch:
+    def test_watch_current(self):
+        s = V2Store()
+        w = s.watch("/w", recursive=True)
+        s.set("/w/k", value="1")
+        ev = w.wait(timeout=2)
+        assert ev is not None and ev.action == "set"
+        assert ev.node.key == "/w/k"
+
+    def test_watch_history(self):
+        s = V2Store()
+        s.set("/h", value="old")
+        idx = s.index
+        s.set("/h", value="new")
+        w = s.watch("/h", since=idx + 1)
+        ev = w.wait(timeout=2)
+        assert ev is not None and ev.node.modified_index == idx + 1
+
+    def test_expire_event_delivered(self):
+        s = V2Store()
+        s.set("/e", value="x", ttl=0.05)
+        w = s.watch("/e")
+        time.sleep(0.08)
+        s.delete_expired_keys()
+        ev = w.wait(timeout=2)
+        assert ev is not None and ev.action == "expire"
