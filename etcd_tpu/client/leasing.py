@@ -40,6 +40,9 @@ class LeasingKV:
         self._lock = threading.Lock()
         self._cache: Dict[bytes, Optional[sapi.KeyValue]] = {}
         self._owned: Dict[bytes, int] = {}  # key -> marker create_rev
+        # key -> header captured at acquisition; cache hits serve it so
+        # header.revision never regresses to 0 (ref: leasing/kv.go Get).
+        self._hdr: Dict[bytes, sapi.ResponseHeader] = {}
         self._acquiring: set = set()  # keys mid-acquisition
         self._revoked_early: set = set()  # REVOKE seen while acquiring
         self.cache_hits = 0
@@ -57,6 +60,7 @@ class LeasingKV:
             owned = list(self._owned)
             self._owned.clear()
             self._cache.clear()
+            self._hdr.clear()
         for key in owned:
             try:
                 self.c.delete(self.pfx + key)
@@ -72,7 +76,7 @@ class LeasingKV:
                 self.cache_hits += 1
                 kv = self._cache.get(key)
                 return sapi.RangeResponse(
-                    header=sapi.ResponseHeader(),
+                    header=self._hdr.get(key, sapi.ResponseHeader()),
                     kvs=[kv] if kv is not None else [],
                     count=1 if kv is not None else 0,
                 )
@@ -106,6 +110,7 @@ class LeasingKV:
                     if not poisoned:
                         self._owned[key] = resp.header.revision
                         self._cache[key] = rr.kvs[0] if rr.kvs else None
+                        self._hdr[key] = resp.header
                 if poisoned:
                     # A REVOKE raced our acquisition: release right away
                     # so the waiting writer proceeds.
@@ -149,6 +154,7 @@ class LeasingKV:
                     pr = resp.responses[0].response_put
                     with self._lock:
                         if key in self._owned:
+                            self._hdr[key] = resp.header
                             prev = self._cache.get(key)
                             rev = resp.header.revision
                             self._cache[key] = sapi.KeyValue(
@@ -164,6 +170,7 @@ class LeasingKV:
                 with self._lock:  # lost ownership mid-flight
                     self._owned.pop(key, None)
                     self._cache.pop(key, None)
+                    self._hdr.pop(key, None)
                 continue
             # Non-owner: write directly if unleased, else request revoke.
             txn = sapi.TxnRequest(
@@ -176,7 +183,13 @@ class LeasingKV:
                     request_put=sapi.PutRequest(key=key, value=value)
                 )],
                 failure=[sapi.RequestOp(
-                    request_put=sapi.PutRequest(key=marker, value=REVOKE)
+                    # ignore_lease keeps the marker bound to the OWNER's
+                    # session lease: if the owner died, the marker still
+                    # expires with that lease instead of living forever
+                    # (ref: client/v3/leasing/kv.go:410 WithIgnoreLease).
+                    request_put=sapi.PutRequest(
+                        key=marker, value=REVOKE, ignore_lease=True
+                    )
                 )],
             )
             resp = self.c.txn(txn)
@@ -213,6 +226,7 @@ class LeasingKV:
                         if mine:
                             self._owned.pop(key, None)
                             self._cache.pop(key, None)
+                            self._hdr.pop(key, None)
                     if mine:
                         try:
                             self.c.delete(self.pfx + key)
@@ -223,5 +237,6 @@ class LeasingKV:
                     with self._lock:
                         self._owned.pop(key, None)
                         self._cache.pop(key, None)
+                        self._hdr.pop(key, None)
 
 

@@ -51,11 +51,13 @@ class Syncer:
     # -- make-mirror (etcdctl) -------------------------------------------------
 
     def mirror_to(self, dest: Client, dest_prefix: Optional[bytes] = None,
-                  max_txns: int = 0, base_only: bool = False) -> int:
+                  max_txns: int = 0, base_only: bool = False,
+                  stop=None) -> int:
         """Copy base then stream updates into `dest`; returns keys
         mirrored. base_only skips the update stream; max_txns>0 bounds
         the update phase (testing/one-shot); max_txns=0 streams until
-        interrupted (ref: make_mirror_command.go)."""
+        interrupted (ref: make_mirror_command.go). `stop` is an optional
+        threading.Event-like object checked between batches."""
         rev, kvs = self.sync_base()
         self.rev = rev
 
@@ -74,6 +76,8 @@ class Syncer:
         try:
             applied = 0
             while max_txns == 0 or applied < max_txns:
+                if stop is not None and stop.is_set():
+                    break
                 got = h.get(timeout=0.5)
                 if got is None:
                     continue
@@ -85,7 +89,7 @@ class Syncer:
                     else:
                         dest.delete(rewrite(ev.kv.key))
                     applied += 1
-                    if applied >= max_txns:
+                    if max_txns and applied >= max_txns:
                         break
             return count
         finally:

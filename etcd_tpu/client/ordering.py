@@ -37,34 +37,44 @@ class OrderingKV:
         self._lock = threading.Lock()
         self._prev_rev = 0
 
-    def _check(self, header: sapi.ResponseHeader):
+    def _violated(self, header: sapi.ResponseHeader) -> bool:
         with self._lock:
             if header.revision < self._prev_rev:
-                err = OrderViolationError(
-                    f"revision {header.revision} < previously seen "
-                    f"{self._prev_rev}"
-                )
-                if self.violation_fn is not None:
-                    self.violation_fn(err)
-                raise err
+                return True
             self._prev_rev = max(self._prev_rev, header.revision)
+            return False
+
+    def _do(self, op: Callable[[], object], retry: bool):
+        """Run op; on an order violation apply the remedy (endpoint
+        rotate) and — for READS only — retry ONCE before raising, the
+        way the reference reissues the request after the violation
+        closure runs (ordering/kv.go). Mutations are never re-executed:
+        the first attempt already committed, and replaying it would
+        double-apply the write."""
+        resp = op()
+        if not self._violated(resp.header):
+            return resp
+        err = OrderViolationError(
+            f"revision {resp.header.revision} < previously seen revision"
+        )
+        if self.violation_fn is None:
+            raise err
+        self.violation_fn(err)
+        if not retry:
+            raise err
+        resp = op()
+        if self._violated(resp.header):
+            raise err
+        return resp
 
     def get(self, key: bytes, **kw) -> sapi.RangeResponse:
-        resp = self.c.get(key, **kw)
-        self._check(resp.header)
-        return resp
+        return self._do(lambda: self.c.get(key, **kw), retry=True)
 
     def put(self, key: bytes, value: bytes, **kw) -> sapi.PutResponse:
-        resp = self.c.put(key, value, **kw)
-        self._check(resp.header)
-        return resp
+        return self._do(lambda: self.c.put(key, value, **kw), retry=False)
 
     def delete(self, key: bytes, **kw) -> sapi.DeleteRangeResponse:
-        resp = self.c.delete(key, **kw)
-        self._check(resp.header)
-        return resp
+        return self._do(lambda: self.c.delete(key, **kw), retry=False)
 
     def txn(self, req: sapi.TxnRequest) -> sapi.TxnResponse:
-        resp = self.c.txn(req)
-        self._check(resp.header)
-        return resp
+        return self._do(lambda: self.c.txn(req), retry=False)

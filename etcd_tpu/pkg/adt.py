@@ -18,6 +18,38 @@ import random
 from typing import Any, Callable, Iterator, List, Optional, Tuple
 
 
+class _Inf:
+    """True +inf interval endpoint: compares greater than every key of
+    any type (bytes or int). Used for open-ended watch ranges, where any
+    finite byte-string stand-in would miss keys sorting above it."""
+
+    __slots__ = ()
+
+    def __lt__(self, other):
+        return False
+
+    def __le__(self, other):
+        return other is INF
+
+    def __gt__(self, other):
+        return other is not INF
+
+    def __ge__(self, other):
+        return True
+
+    def __eq__(self, other):
+        return other is INF
+
+    def __hash__(self):
+        return hash("adt.INF")
+
+    def __repr__(self):
+        return "INF"
+
+
+INF = _Inf()
+
+
 class Interval:
     __slots__ = ("begin", "end")
 

@@ -28,7 +28,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
-from ...pkg.adt import Interval, IntervalTree, point_interval
+from ...pkg.adt import INF, Interval, IntervalTree, point_interval
 from . import metrics as mmet
 from .kv import Event, EventType, KeyValue
 from .kvstore import KVStore
@@ -38,9 +38,10 @@ from .revision import rev_to_bytes
 # reference uses chanBufLen 128 on the watch channel.
 DEFAULT_BUFFER_CAP = 1024
 
-# Interval-tree stand-in for an open-ended watch range (end=b"", the
-# \x00 sentinel): sorts above any practical key.
-WATCH_OPEN_MAX = b"\xff" * 256
+# Open-ended watch ranges (end = the "\x00" sentinel) use a true +inf
+# endpoint in the interval tree — any finite byte string would miss
+# events for keys sorting above it.
+WATCH_OPEN_MAX = INF
 
 
 @dataclass

@@ -220,3 +220,104 @@ class TestSnapshotSave:
         assert os.path.getsize(path) == n
         assert not os.path.exists(path + ".part")
         c.close()
+
+
+class TestAdvisorRegressions:
+    """Round-1 advisor findings (ADVICE.md) must stay fixed."""
+
+    def test_mirror_streams_full_batches_at_max_txns_0(self, member):
+        # A txn writing two keys produces ONE watch batch with two
+        # events; with max_txns=0 (stream forever) both must be applied
+        # (the old guard broke out of the batch after the first event).
+        from etcd_tpu.client.mirror import Syncer
+
+        _, rpc = member
+        src = Client([rpc.addr])
+        dest = Client([rpc.addr])
+        src.put(b"mirr-src/seed", b"s")
+        sy = Syncer(src, b"mirr-src/")
+        stop = threading.Event()
+        t = threading.Thread(
+            target=lambda: sy.mirror_to(
+                dest, dest_prefix=b"mirr-dst/", max_txns=0, stop=stop
+            ),
+            daemon=True,
+        )
+        t.start()
+        time.sleep(0.3)  # let the update stream attach
+        src.txn(sapi.TxnRequest(success=[
+            sapi.RequestOp(request_put=sapi.PutRequest(
+                key=b"mirr-src/a", value=b"1")),
+            sapi.RequestOp(request_put=sapi.PutRequest(
+                key=b"mirr-src/b", value=b"2")),
+        ]))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if (dest.get(b"mirr-dst/a").count
+                    and dest.get(b"mirr-dst/b").count):
+                break
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=5)
+        assert dest.get(b"mirr-dst/a").kvs[0].value == b"1"
+        assert dest.get(b"mirr-dst/b").kvs[0].value == b"2"
+        src.close()
+        dest.close()
+
+    def test_revoke_stamp_keeps_owner_lease(self, member):
+        # The REVOKE stamp must not detach the marker from the owner's
+        # session lease (ignore_lease), or a dead owner's marker never
+        # expires and writers block forever.
+        _, rpc = member
+        c1, c2 = Client([rpc.addr]), Client([rpc.addr])
+        owner = LeasingKV(c1, "_rl/")
+        owner.get(b"rlk")  # acquire marker bound to owner session lease
+        marker = b"_rl/rlk"
+        lease_before = c2.get(marker).kvs[0].lease
+        assert lease_before == owner.session.lease_id
+        # Simulate a dead owner: watcher gone, marker left behind.
+        owner._closed = True
+        owner._watch.cancel()
+        owner._watcher.join(timeout=5)
+        writer = LeasingKV(c2, "_rl/")
+        with pytest.raises(TimeoutError):
+            writer.put(b"rlk", b"w", timeout=1.0)
+        kv = c2.get(marker).kvs[0]
+        assert kv.value == b"REVOKE"
+        assert kv.lease == lease_before, "REVOKE stamp detached the lease"
+        # Owner's lease expiry (session close revokes) frees the writer.
+        owner.session.close()
+        writer.put(b"rlk", b"w2", timeout=5.0)
+        assert c2.get(b"rlk").kvs[0].value == b"w2"
+        writer.close()
+        c1.close()
+        c2.close()
+
+    def test_cached_get_serves_acquisition_header(self, member):
+        _, rpc = member
+        c = Client([rpc.addr])
+        lkv = LeasingKV(c, "_rh/")
+        c.put(b"rhk", b"v")
+        first = lkv.get(b"rhk")
+        assert first.kvs[0].value == b"v"
+        cached = lkv.get(b"rhk")
+        assert lkv.cache_hits >= 1
+        assert cached.header.revision > 0
+        lkv.close()
+        c.close()
+
+    def test_ordering_retries_once_after_remedy(self, member):
+        _, rpc = member
+        c = Client([rpc.addr])
+        kv = OrderingKV(c)
+
+        def remedy(_err):
+            # Models switching to a caught-up endpoint.
+            kv._prev_rev = 0
+
+        kv.violation_fn = remedy
+        kv.put(b"ord-r", b"x")
+        kv._prev_rev = 10**9
+        resp = kv.get(b"ord-r")  # violation -> remedy -> retried, no raise
+        assert resp.kvs[0].value == b"x"
+        c.close()

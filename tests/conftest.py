@@ -16,6 +16,12 @@ bench.py / the driver keep the TPU path.
 import os
 import sys
 
+# Keep every subprocess spawned by tests (e2e members, dryrun re-execs)
+# off the single-client TPU tunnel: without the pool var the axon
+# sitecustomize skips PJRT registration, so children come up CPU-only
+# instead of dialing (and wedging) the relay.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

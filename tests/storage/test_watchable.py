@@ -135,3 +135,17 @@ def test_two_streams_independent(tmp_path):
     assert ws2.poll(1.0).events[0].kv.value == b"v2"
     assert ws1.pending() == 0
     b.close()
+
+
+def test_open_range_watch_catches_high_keys(tmp_path):
+    # ADVICE regression: the open-end watch interval must use a true
+    # +inf endpoint — a key of >=256 bytes of 0xff sorts above any
+    # finite byte-string sentinel.
+    b, s = make(tmp_path)
+    ws = s.new_watch_stream()
+    ws.watch(b"\x00", b"")  # whole keyspace (end=b"": open range)
+    high = b"\xff" * 300
+    s.put(high, b"max")
+    r = ws.poll(1.0)
+    assert r is not None and r.events[0].kv.key == high
+    b.close()
