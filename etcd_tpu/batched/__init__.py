@@ -16,11 +16,19 @@ program:
                raft/raft.go stepLeader/stepFollower/stepCandidate) +
                tick/propose/emit phases and the all-device message router
                (a transpose over the dense (group, replica) layout).
-- ``engine``:  the host-facing MultiRaftEngine with the
-               HasReady → Ready → persist → send → Advance contract of
-               ``raft.RawNode``, batched over all groups.
+- ``engine``:  the closed-loop MultiRaftEngine (bench/simulation: the
+               whole network round-trips on device).
+- ``rawnode``: BatchedRawNode — the production Ready contract (persist →
+               apply → send → advance) with the host payload arena.
+- ``node``:    BatchedNode — the raft.Node plugin boundary served by the
+               device engine (the ``raft-backend=tpu`` construction path).
+- ``hosting``: MultiRaftMember/MultiRaftCluster — G groups × R members
+               served end-to-end (native WAL, per-group KV apply).
 """
 
 from .state import BatchedConfig, BatchedState, init_state  # noqa: F401
 from .step import make_step_round  # noqa: F401
 from .engine import MultiRaftEngine  # noqa: F401
+from .rawnode import BatchedRawNode, BatchedReady, RowRestore  # noqa: F401
+from .node import BatchedNode  # noqa: F401
+from .hosting import MultiRaftCluster, MultiRaftMember  # noqa: F401

@@ -1,0 +1,438 @@
+"""Multi-raft hosting: G consensus groups served by R member processes,
+each member stepping its replica slot of EVERY group in one device
+program per round.
+
+This is the scale-out shape the reference's raft library was designed
+for but never shipped a host for ("systems which have thousands of Raft
+groups per process", ref: raft/tracker/inflights.go:71-73): a
+``MultiRaftMember`` owns
+
+* a ``BatchedRawNode`` over rows = G groups (slot = this member),
+* ONE write-ahead log for all groups (the native C++ segmented WAL,
+  records framed with a group id; one fsync covers every group's
+  hardstate+entries for the round — the batched analog of wal.Save,
+  ref: server/storage/wal/wal.go:920-953),
+* a per-group KV apply target (the 1k-shard KV service),
+* a round loop enforcing the reference's ordering per group:
+  persist (fsync) → apply → send → advance
+  (ref: server/etcdserver/raft.go:226-268; apply-before-send lets
+  outbound snapshot messages carry app state at an index ≥ the device
+  ring floor).
+
+Members exchange per-round message batches. ``InProcRouter`` wires
+members in one process (tests, single-host demos); the TCP fabric for
+real deployments reuses the same ``deliver()`` entry point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..native.walog import Walog, read_all as wal_read_all
+from ..raft.types import Message, MessageType, Snapshot, SnapshotMetadata
+from .rawnode import BatchedRawNode, BatchedReady, RowRestore
+from .state import BatchedConfig, LEADER
+from .step import T_SNAP
+
+# WAL record types (the native walog carries opaque frames; these tags
+# make one log serve every group — ref: walpb's entry/state/snapshot
+# record types, server/storage/wal/walpb/record.pb.go).
+RT_ENTRY = 1  # group:u32 index:u64 term:u64 len:u32 data
+RT_HARDSTATE = 2  # group:u32 term:u64 vote:u32 commit:u64
+RT_SNAPSHOT = 3  # group:u32 index:u64 term:u64 len:u32 app_data
+
+
+def _pack_entry(group: int, index: int, term: int, data: bytes) -> bytes:
+    return struct.pack("<IQQI", group, index, term, len(data)) + data
+
+
+def _unpack_entry(b: bytes) -> Tuple[int, int, int, bytes]:
+    g, i, t, ln = struct.unpack_from("<IQQI", b)
+    off = struct.calcsize("<IQQI")
+    return g, i, t, b[off:off + ln]
+
+
+def _pack_hs(group: int, term: int, vote: int, commit: int) -> bytes:
+    return struct.pack("<IQIQ", group, term, vote, commit)
+
+
+def _unpack_hs(b: bytes) -> Tuple[int, int, int, int]:
+    return struct.unpack_from("<IQIQ", b)
+
+
+def _pack_snap(group: int, index: int, term: int, data: bytes) -> bytes:
+    return struct.pack("<IQQI", group, index, term, len(data)) + data
+
+
+_unpack_snap = _unpack_entry
+
+
+class GroupKV:
+    """The applied state machine of one group: a KV map fed committed
+    payloads ``op key \\x00 value`` (ref: contrib/raftexample/kvstore.go
+    gob-encoded kv pairs; here a flat length-prefixed frame)."""
+
+    def __init__(self) -> None:
+        self.data: Dict[bytes, bytes] = {}
+
+    def apply(self, payload: bytes) -> None:
+        op, rest = payload[:1], payload[1:]
+        if op == b"P":
+            k, v = rest.split(b"\x00", 1)
+            self.data[k] = v
+        elif op == b"D":
+            self.data.pop(rest, None)
+
+    def snapshot(self) -> bytes:
+        return json.dumps(
+            {k.hex(): v.hex() for k, v in self.data.items()}
+        ).encode()
+
+    def restore(self, blob: bytes) -> None:
+        self.data = {
+            bytes.fromhex(k): bytes.fromhex(v)
+            for k, v in json.loads(blob.decode()).items()
+        } if blob else {}
+
+    @staticmethod
+    def put_payload(key: bytes, value: bytes) -> bytes:
+        return b"P" + key + b"\x00" + value
+
+    @staticmethod
+    def delete_payload(key: bytes) -> bytes:
+        return b"D" + key
+
+
+class MultiRaftMember:
+    """One member process: slot `member_id-1` of every group."""
+
+    def __init__(
+        self,
+        member_id: int,
+        num_members: int,
+        num_groups: int,
+        data_dir: str,
+        cfg: Optional[BatchedConfig] = None,
+        tick_interval: float = 0.02,
+        send_fn: Optional[Callable[[int, List[Tuple[int, Message]]], None]] = None,
+    ) -> None:
+        self.id = member_id
+        self.slot = member_id - 1
+        self.g = num_groups
+        self.cfg = cfg or BatchedConfig(
+            num_groups=num_groups,
+            num_replicas=num_members,
+            window=64,
+            max_ents_per_msg=8,
+            max_props_per_round=4,
+            election_timeout=10,
+            heartbeat_timeout=1,
+            pre_vote=True,
+            check_quorum=True,
+            auto_compact=True,  # floor chases applied; snapshots are
+            # generated on demand at send time (apply-before-send keeps
+            # host state ≥ floor)
+        )
+        assert self.cfg.num_groups == num_groups
+        self.dir = os.path.join(data_dir, f"member-{member_id}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.kvs = [GroupKV() for _ in range(num_groups)]
+        self.applied_index = np.zeros(num_groups, np.int64)
+        self._send = send_fn  # set by the router/transport
+        self._lock = threading.Lock()
+        self.tick_interval = tick_interval
+
+        restore = self._replay()
+        groups = np.arange(num_groups, dtype=np.int32)
+        slots = np.full(num_groups, self.slot, np.int32)
+        self.rn = BatchedRawNode(
+            self.cfg, groups=groups, slots=slots, restore=restore
+        )
+        if restore:
+            for row, rr in restore.items():
+                self.applied_index[row] = rr.applied
+                # Re-apply WAL tail beyond the app snapshot: committed
+                # entries land again via the first Ready (applied mirror
+                # starts at the snapshot index).
+        wal_dir = os.path.join(self.dir, "wal")
+        fresh = not (
+            os.path.isdir(wal_dir)
+            and any(f.endswith(".wal") for f in os.listdir(wal_dir))
+        )
+        self.wal = Walog(wal_dir, create=fresh)
+
+        self._stopped = threading.Event()
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._runner = threading.Thread(target=self._run_loop, daemon=True)
+
+    def start(self) -> None:
+        self._ticker.start()
+        self._runner.start()
+
+    # -- boot ------------------------------------------------------------------
+
+    def _replay(self) -> Dict[int, RowRestore]:
+        wal_dir = os.path.join(self.dir, "wal")
+        if not os.path.isdir(wal_dir) or not os.listdir(wal_dir):
+            return {}
+        rows: Dict[int, RowRestore] = defaultdict(RowRestore)
+        ents: Dict[int, List[Tuple[int, int, bytes]]] = defaultdict(list)
+        snaps: Dict[int, Tuple[int, int, bytes]] = {}
+        for rtype, data, _seq, _meta in wal_read_all(wal_dir):
+            if rtype == RT_HARDSTATE:
+                g, term, vote, commit = _unpack_hs(data)
+                rr = rows[g]
+                rr.term, rr.vote, rr.commit = term, vote, commit
+            elif rtype == RT_ENTRY:
+                g, i, t, d = _unpack_entry(data)
+                lst = ents[g]
+                while lst and lst[-1][0] >= i:
+                    lst.pop()  # WAL truncate-and-append semantics
+                lst.append((i, t, d))
+            elif rtype == RT_SNAPSHOT:
+                g, i, t, d = _unpack_snap(data)
+                snaps[g] = (i, t, d)
+                ents[g] = [e for e in ents[g] if e[0] > i]
+        restore: Dict[int, RowRestore] = {}
+        for g in set(rows) | set(ents) | set(snaps):
+            rr = rows[g]
+            si, st_, sd = snaps.get(g, (0, 0, b""))
+            self.kvs[g].restore(sd)
+            rr.snap_index, rr.snap_term = si, st_
+            rr.applied = si
+            rr.entries = [e for e in ents.get(g, []) if e[0] > si]
+            lim = rr.snap_index + len(rr.entries)
+            rr.commit = min(rr.commit, lim) if rr.commit else rr.commit
+            restore[g] = rr
+        return restore
+
+    # -- loops -----------------------------------------------------------------
+
+    def _tick_loop(self) -> None:
+        while not self._stopped.wait(self.tick_interval):
+            self.rn.tick()
+
+    def _run_loop(self) -> None:
+        while not self._stopped.is_set():
+            if not self.rn.has_work():
+                time.sleep(self.tick_interval / 4)
+                continue
+            self.run_round()
+
+    def run_round(self) -> BatchedReady:
+        """One Ready cycle for all groups: device round → WAL fsync →
+        apply → send (snapshots attached at current applied state) →
+        advance."""
+        rd = self.rn.advance_round()
+        with self._lock:
+            # 1. persist (one fsync for every group)
+            for row, term, vote, commit in rd.hardstates:
+                self.wal.append(RT_HARDSTATE, _pack_hs(row, term, vote, commit))
+            for row, i, t, d in rd.entries:
+                self.wal.append(RT_ENTRY, _pack_entry(row, i, t, d))
+            if rd.must_sync:
+                self.wal.flush(sync=True)
+            # 2. apply committed payloads
+            for row, items in rd.committed:
+                for i, _t, d in items:
+                    if d:
+                        self.kvs[row].apply(d)
+                    self.applied_index[row] = i
+            # 3a. build outbound batch (MsgSnap carries app state at the
+            #     host's applied watermark, ≥ the device floor after
+            #     step 2; the floor metadata rides in m.index/log_term)
+            out: List[Tuple[int, Message]] = []
+            ring = self.rn.latest_ring()
+            w = self.cfg.window
+            for row, m in rd.messages:
+                if int(m.type) == T_SNAP:
+                    idx = int(self.applied_index[row])
+                    # Term at the applied watermark: from the ring above
+                    # the floor, else the floor term riding in the
+                    # message (m.log_term) — the receiver persists it
+                    # and restores its ring floor from it.
+                    t = (
+                        int(ring[row, idx % w])
+                        if idx > m.index else m.log_term
+                    )
+                    m.snapshot = Snapshot(
+                        metadata=SnapshotMetadata(index=idx, term=t),
+                        data=self.kvs[row].snapshot(),
+                    )
+                out.append((row, m))
+        # 3b. send OUTSIDE the lock: delivery takes the receiver's lock,
+        #     and two members sending to each other must not deadlock.
+        if out and self._send is not None:
+            self._send(self.id, out)
+        # 4. advance
+        self.rn.advance()
+        return rd
+
+    # -- wire ------------------------------------------------------------------
+
+    def deliver(self, group: int, m: Message) -> None:
+        """Entry point for the router/transport."""
+        if self._stopped.is_set():
+            return
+        if int(m.type) == int(MessageType.MsgSnap):
+            # Restore app state before the device sees the install — all
+            # under _lock so run_round's apply step can't interleave
+            # stale entries into the freshly restored state.
+            idx = m.snapshot.metadata.index
+            with self._lock:
+                if idx > self.applied_index[group]:
+                    self.kvs[group].restore(m.snapshot.data)
+                    self.applied_index[group] = idx
+                    self.rn.install_snapshot_state(group, idx)
+                    # WAL-record the snapshot before any post-restore
+                    # state can be acknowledged.
+                    self.wal.append(
+                        RT_SNAPSHOT,
+                        _pack_snap(group, idx, m.snapshot.metadata.term,
+                                   m.snapshot.data),
+                    )
+                    self.wal.flush(sync=True)
+        self.rn.step(group, m)
+
+    # -- API -------------------------------------------------------------------
+
+    def propose(self, group: int, payload: bytes) -> bool:
+        """Propose on this member; returns False if this member isn't
+        the group's leader (the caller redirects, like etcd clients
+        following leader hints)."""
+        if not self.rn.is_leader(group):
+            return False
+        self.rn.propose(group, payload)
+        return True
+
+    def leader_of(self, group: int) -> int:
+        """Member id this member believes leads `group` (0 unknown)."""
+        return self.rn.lead(group)
+
+    def is_leader(self, group: int) -> bool:
+        return self.rn.is_leader(group)
+
+    def campaign(self, groups) -> None:
+        self.rn.campaign(np.asarray(groups))
+
+    def get(self, group: int, key: bytes) -> Optional[bytes]:
+        """Serializable read from local applied state."""
+        return self.kvs[group].data.get(key)
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        for t in (self._ticker, self._runner):
+            if t.is_alive() and t is not threading.current_thread():
+                t.join(timeout=5)
+        with self._lock:
+            self.wal.flush(sync=True)
+            self.wal.close()
+
+
+class InProcRouter:
+    """Wires MultiRaftMembers in one process; per-destination worker
+    queues preserve per-peer ordering (rafthttp's stream semantics)
+    without blocking the sender's round loop."""
+
+    def __init__(self) -> None:
+        self.members: Dict[int, MultiRaftMember] = {}
+        self._isolated: set = set()
+        self._lock = threading.Lock()
+
+    def attach(self, m: MultiRaftMember) -> None:
+        self.members[m.id] = m
+        m._send = self.send
+
+    def send(self, from_id: int, batch: List[Tuple[int, Message]]) -> None:
+        with self._lock:
+            if from_id in self._isolated:
+                return
+            targets = {
+                to: mem for to, mem in self.members.items()
+                if to not in self._isolated
+            }
+        for group, msg in batch:
+            mem = targets.get(msg.to)
+            if mem is not None:
+                try:
+                    mem.deliver(group, msg)
+                except Exception:  # noqa: BLE001 — drop, like a lossy net
+                    pass
+
+    def isolate(self, member_id: int) -> None:
+        with self._lock:
+            self._isolated.add(member_id)
+
+    def heal(self, member_id: int) -> None:
+        with self._lock:
+            self._isolated.discard(member_id)
+
+
+class MultiRaftCluster:
+    """Convenience harness: R members × G groups in one process."""
+
+    def __init__(self, data_dir: str, num_members: int = 3,
+                 num_groups: int = 16,
+                 cfg: Optional[BatchedConfig] = None) -> None:
+        self.router = InProcRouter()
+        self.members: Dict[int, MultiRaftMember] = {}
+        for mid in range(1, num_members + 1):
+            m = MultiRaftMember(
+                mid, num_members, num_groups, data_dir, cfg=cfg
+            )
+            self.router.attach(m)
+            self.members[mid] = m
+        for m in self.members.values():
+            m.start()
+
+    def wait_leaders(self, timeout: float = 30.0) -> np.ndarray:
+        """Block until every group has an elected leader; returns the
+        per-group leader member id."""
+        deadline = time.monotonic() + timeout
+        g = next(iter(self.members.values())).g
+        while time.monotonic() < deadline:
+            leads = np.zeros(g, np.int64)
+            for m in self.members.values():
+                mask = m.rn.m_role == LEADER
+                leads[mask] = m.id
+            if (leads > 0).all():
+                return leads
+            time.sleep(0.05)
+        raise TimeoutError("groups without leader")
+
+    def put(self, group: int, key: bytes, value: bytes,
+            timeout: float = 10.0) -> None:
+        """Client write: find the leader, propose, wait for local apply
+        (read-your-write via the leader's applied state)."""
+        payload = GroupKV.put_payload(key, value)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for m in self.members.values():
+                if not m.propose(group, payload):
+                    continue
+                # Wait briefly for local apply; a stale (partitioned)
+                # leader accepts but never commits — fall through and
+                # retry on another member (retries are idempotent:
+                # the orphaned entry is truncated by the new leader's
+                # conflicting append).
+                sub = min(deadline, time.monotonic() + 2.0)
+                while time.monotonic() < sub:
+                    if m.get(group, key) == value:
+                        return
+                    time.sleep(0.005)
+            time.sleep(0.02)
+        raise TimeoutError(f"put for group {group} did not commit")
+
+    def stop(self) -> None:
+        for m in self.members.values():
+            m.stop()

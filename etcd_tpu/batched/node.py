@@ -1,0 +1,326 @@
+"""BatchedNode: the raft.Node plugin boundary served by the device
+engine.
+
+This is the `--raft-backend=tpu` construction path (ref: the single
+raft-construction site in server/etcdserver/bootstrap.go:473-536 and
+contrib/raftexample/raft.go:87): hosts that drive `raft.node.Node`
+(raftexample, EtcdServer) can construct a ``BatchedNode`` instead and
+run unchanged — same Ready/persist/send/Advance cycle, same Message
+wire types — while the consensus math executes in the batched device
+kernel (one group here; the multi-group hosting layer lives in
+hosting.py).
+
+Differences from the host Node, by design:
+* proposals are forwarded to the leader host-side (the kernel has no
+  MsgProp lane); with no known leader they are dropped, like the
+  reference's ErrProposalDropped path (ref: raft/node.go:425-462);
+* log compaction is host-controlled: the host calls ``compact(index)``
+  after taking an app snapshot, which moves the device ring floor, and
+  outbound MsgSnap messages carry that snapshot's data — keeping the
+  floor and the app snapshot index equal by construction;
+* conf changes are not yet on the device path (joint-consensus mask
+  swaps land with the confchange work; see VERDICT.md item 5).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..raft.errors import RaftError
+from ..raft.raft import SoftState, StateType
+from ..raft.rawnode import BasicStatus, Ready, Status
+from ..raft.types import (
+    Entry,
+    EntryType,
+    HardState,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+    ConfState,
+)
+from .rawnode import BatchedRawNode, RowRestore
+from .state import BatchedConfig, LEADER
+from .step import T_SNAP
+
+
+class ProposalDroppedError(RaftError):
+    """ref: raft.ErrProposalDropped."""
+
+
+class BatchedNode:
+    """Single-group raft.Node over the batched device engine."""
+
+    def __init__(
+        self,
+        node_id: int,
+        peers: List[int],
+        election_tick: int = 10,
+        heartbeat_tick: int = 1,
+        window: int = 256,
+        max_ents_per_msg: int = 8,
+        max_props_per_round: int = 8,
+        pre_vote: bool = True,
+        check_quorum: bool = True,
+        restore: Optional[RowRestore] = None,
+    ) -> None:
+        self.id = node_id
+        self.peers = sorted(peers)
+        r = len(self.peers)
+        assert self.peers == list(range(1, r + 1)), (
+            "batched backend uses dense member ids 1..R"
+        )
+        self.cfg = BatchedConfig(
+            num_groups=1,
+            num_replicas=r,
+            window=window,
+            max_ents_per_msg=max_ents_per_msg,
+            max_props_per_round=max_props_per_round,
+            election_timeout=election_tick,
+            heartbeat_timeout=heartbeat_tick,
+            pre_vote=pre_vote,
+            check_quorum=check_quorum,
+            auto_compact=False,  # host-controlled via compact()
+        )
+        self.rn = BatchedRawNode(
+            self.cfg,
+            groups=np.array([0], np.int32),
+            slots=np.array([node_id - 1], np.int32),
+            restore={0: restore} if restore else None,
+        )
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stopped = False
+        # Latest app snapshot (index, term, data): attached to outbound
+        # MsgSnap; index == device ring floor by the compact() contract.
+        self._app_snap: Optional[Snapshot] = None
+        # Inbound snapshot data staged until the device confirms the
+        # install (keyed by snapshot index).
+        self._inbound_snaps: Dict[int, Snapshot] = {}
+        # Host-side proposal forwards waiting for the next Ready.
+        self._fwd: List[Message] = []
+
+    # -- Node interface --------------------------------------------------------
+
+    def tick(self) -> None:
+        self.rn.tick()
+        self._work.set()
+
+    def campaign(self) -> None:
+        self.rn.campaign([0])
+        self._work.set()
+
+    def propose(self, data: bytes, timeout: Optional[float] = None) -> None:
+        """Leader: queue for the next round. Follower: forward to the
+        known leader over the wire (host-side MsgProp analog). The host
+        Node blocks until the proposal is accepted into the state
+        machine, so poll for a known leader up to `timeout` before
+        dropping (ref: node.go:464-501 stepWithWaitOption)."""
+        deadline = time.monotonic() + (timeout if timeout else 5.0)
+        while True:
+            if self.rn.is_leader(0):
+                self.rn.propose(0, data)
+                self._work.set()
+                return
+            lead = self.rn.lead(0)
+            if lead != 0:
+                with self._lock:
+                    self._fwd.append(
+                        Message(
+                            type=MessageType.MsgProp, to=lead, from_=self.id,
+                            entries=[Entry(data=data)],
+                        )
+                    )
+                self._work.set()
+                return
+            if self._stopped or time.monotonic() >= deadline:
+                raise ProposalDroppedError("no leader; proposal dropped")
+            time.sleep(0.01)
+
+    def propose_conf_change(self, cc, timeout: Optional[float] = None) -> None:
+        raise NotImplementedError(
+            "conf changes on the batched backend land with the "
+            "joint-consensus mask-swap work"
+        )
+
+    def apply_conf_change(self, cc) -> ConfState:
+        return ConfState(voters=list(self.peers))
+
+    def step(self, m: Message) -> None:
+        if m.type == MessageType.MsgProp:
+            # Forwarded proposal: accept if we lead, else re-forward once
+            # more toward our view of the leader; drop without one.
+            if self.rn.is_leader(0):
+                for e in m.entries:
+                    self.rn.propose(0, e.data)
+                self._work.set()
+                return
+            lead = self.rn.lead(0)
+            if lead == 0 or lead == m.from_:
+                raise ProposalDroppedError("no leader; proposal dropped")
+            with self._lock:
+                self._fwd.append(
+                    Message(
+                        type=MessageType.MsgProp, to=lead, from_=self.id,
+                        entries=m.entries,
+                    )
+                )
+            self._work.set()
+            return
+        if m.type == MessageType.MsgSnap:
+            # Stash app data; the device confirms the install and the
+            # Ready carries the snapshot to the host for restore.
+            with self._lock:
+                self._inbound_snaps[m.snapshot.metadata.index] = m.snapshot
+        self.rn.step(0, m)
+        self._work.set()
+
+    def read_index(self, rctx: bytes) -> None:
+        raise NotImplementedError(
+            "ReadIndex on the batched backend lands with the host-bridge "
+            "work"
+        )
+
+    def transfer_leadership(self, lead: int, transferee: int) -> None:
+        raise NotImplementedError
+
+    def report_unreachable(self, vid: int) -> None:
+        pass
+
+    def report_snapshot(self, vid: int, failure: bool) -> None:
+        pass
+
+    def has_ready(self) -> bool:
+        return self.rn.has_work()
+
+    def ready(self, timeout: Optional[float] = None) -> Optional[Ready]:
+        """Run one device round over the staged inputs and translate the
+        BatchedReady to the host Ready shape. Returns None when there is
+        no work within `timeout`."""
+        if not self.rn.has_work() and not self._fwd:
+            if not self._work.wait(timeout):
+                return None
+        self._work.clear()
+        if self._stopped:
+            return None
+        rd = self.rn.advance_round()
+
+        entries = [
+            Entry(index=i, term=t, data=d, type=EntryType.EntryNormal)
+            for (_row, i, t, d) in rd.entries
+        ]
+        committed = []
+        for _row, items in rd.committed:
+            committed.extend(
+                Entry(index=i, term=t, data=d or b"",
+                      type=EntryType.EntryNormal)
+                for (i, t, d) in items
+            )
+
+        snapshot = Snapshot()
+        if rd.snapshots:
+            _row, idx, term = rd.snapshots[-1]
+            with self._lock:
+                stash = self._inbound_snaps.pop(idx, None)
+                # Drop only staler stashes — a higher-index MsgSnap may
+                # already be queued for a later round.
+                for k in [k for k in self._inbound_snaps if k <= idx]:
+                    del self._inbound_snaps[k]
+            if stash is not None:
+                snapshot = stash
+            else:
+                snapshot = Snapshot(
+                    metadata=SnapshotMetadata(
+                        index=idx, term=term,
+                        conf_state=ConfState(voters=list(self.peers)),
+                    )
+                )
+            self.rn.install_snapshot_state(0, idx)
+
+        messages = []
+        for _row, m in rd.messages:
+            if int(m.type) == T_SNAP:
+                app = self._app_snap
+                if app is None or app.metadata.index < m.snapshot.metadata.index:
+                    # Floor moved without a matching app snapshot (only
+                    # possible transiently); retry next heartbeat.
+                    continue
+                m.snapshot = app
+            messages.append(m)
+        with self._lock:
+            messages.extend(self._fwd)
+            self._fwd.clear()
+
+        hs = HardState(
+            term=int(self.rn._round[0][0]),
+            vote=int(self.rn._round[1][0]),
+            commit=int(self.rn._round[2][0]),
+        )
+        rd_out = Ready(
+            hard_state=hs if rd.hardstates else HardState(),
+            entries=entries,
+            snapshot=snapshot,
+            committed_entries=committed,
+            messages=messages,
+            must_sync=rd.must_sync,
+        )
+        return rd_out
+
+    def advance(self) -> None:
+        self.rn.advance()
+
+    def create_snapshot(self, index: int, confstate: Optional[ConfState],
+                        data: bytes) -> Snapshot:
+        """Build a Snapshot at `index` (≤ committed) with the term taken
+        from the device ring (ref: MemoryStorage.CreateSnapshot,
+        raft/storage.go:180-199). Callable mid-Ready: the host applies
+        committed entries before advance(), so the bound is the
+        in-flight commit."""
+        rn = self.rn
+        bound = max(int(rn.applied[0]), rn.latest_commit(0))
+        assert index <= bound, (index, bound)
+        if index > rn.m_snap[0]:
+            term = int(rn.latest_ring()[0, index % self.cfg.window])
+        else:
+            import jax
+
+            term = int(jax.device_get(rn.state.snap_term)[0])
+        return Snapshot(
+            metadata=SnapshotMetadata(
+                index=index, term=term,
+                conf_state=confstate or ConfState(voters=list(self.peers)),
+            ),
+            data=data,
+        )
+
+    def compact(self, index: int, snapshot: Snapshot) -> None:
+        """Host took an app snapshot at `index`: move the device ring
+        floor there and keep the snapshot for lagging followers."""
+        self._app_snap = snapshot
+        self.rn.compact(0, index)
+
+    def status(self) -> Status:
+        role = int(self.rn.m_role[0])
+        return Status(
+            basic=BasicStatus(
+                id=self.id,
+                hard_state=HardState(
+                    term=int(self.rn.m_term[0]),
+                    vote=int(self.rn.m_vote[0]),
+                    commit=int(self.rn.m_commit[0]),
+                ),
+                soft_state=SoftState(
+                    lead=self.rn.lead(0),
+                    raft_state=StateType(role),
+                ),
+                applied=int(self.rn.applied[0]),
+            )
+        )
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._work.set()

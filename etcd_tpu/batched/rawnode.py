@@ -1,0 +1,619 @@
+"""BatchedRawNode: the RawNode plugin contract over G groups at once.
+
+This is the piece that turns the device step kernel into a *backend*:
+the same logical cycle as the reference's RawNode —
+
+    stage inputs → advance_round() → BatchedReady →
+    persist (WAL) → apply → send → advance()
+
+(ref: raft/rawnode.go:125-179 HasReady/Ready/Advance and the production
+ordering in server/etcdserver/raft.go:158-315) — but for every group in
+one device program. Entry payload bytes never touch the device: the
+host keeps them in a per-row arena keyed by log index, assigns indexes
+to proposals from the phase watermarks the kernel reports (StepAux),
+and re-attaches payloads when draining committed ranges or building
+outbound MsgApp messages.
+
+A *row* is one replica instance this process hosts: (group, slot).
+Topologies:
+
+* hosting process (one replica slot of every group): rows = G,
+  ``slots[i] = s`` constant, messages travel over the wire;
+* in-proc all-replica engine (tests, single-process demos): rows = G*R.
+
+Persistence contract per round (must_sync mirrors raft MustSync,
+ref: raft/node.go:588-595): the caller drains ``BatchedReady`` to its
+WAL and fsyncs BEFORE handing messages to the transport, then applies
+committed entries, then calls ``advance()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..raft.types import (
+    Entry,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+)
+from .state import BatchedConfig, BatchedState, LEADER, I32, init_state
+from .step import (
+    KIND_APP,
+    KIND_APP_RESP,
+    KIND_HB,
+    KIND_HB_RESP,
+    KIND_VOTE,
+    KIND_VOTE_RESP,
+    NUM_KINDS,
+    T_APP,
+    T_APP_RESP,
+    T_HB,
+    T_HB_RESP,
+    T_PREVOTE,
+    T_PREVOTE_RESP,
+    T_SNAP,
+    T_VOTE,
+    T_VOTE_RESP,
+    MsgSlots,
+    make_step_round,
+)
+
+# Inbox lane for each wire type (lanes are capacity classes; handlers
+# dispatch on the type field — see step.py).
+_LANE = {
+    T_VOTE: KIND_VOTE,
+    T_PREVOTE: KIND_VOTE,
+    T_APP: KIND_APP,
+    T_SNAP: KIND_APP,
+    T_HB: KIND_HB,
+    T_VOTE_RESP: KIND_VOTE_RESP,
+    T_PREVOTE_RESP: KIND_VOTE_RESP,
+    T_APP_RESP: KIND_APP_RESP,
+    T_HB_RESP: KIND_HB_RESP,
+}
+
+
+@dataclass
+class RowRestore:
+    """Boot state for one row (from WAL replay / snapshot)."""
+
+    term: int = 0
+    vote: int = 0  # slot+1, 0 = none
+    commit: int = 0
+    applied: int = 0  # host app state watermark (snapshot index)
+    snap_index: int = 0  # log floor
+    snap_term: int = 0
+    entries: List[Tuple[int, int, bytes]] = field(default_factory=list)
+    # (index, term, data) strictly ascending, > snap_index
+
+
+@dataclass
+class BatchedReady:
+    """One round's outstanding work (ref: raft/node.go:52-90 Ready,
+    batched). Drain order: hardstates+entries+snapshots → WAL fsync →
+    apply committed → messages → advance()."""
+
+    hardstates: List[Tuple[int, int, int, int]]  # (row, term, vote, commit)
+    entries: List[Tuple[int, int, int, bytes]]  # (row, index, term, data)
+    # Device-installed snapshot restores this round: (row, index, term).
+    # App-state restore happened host-side when the MsgSnap was staged.
+    snapshots: List[Tuple[int, int, int]]
+    committed: List[Tuple[int, List[Tuple[int, int, Optional[bytes]]]]]
+    # (row, [(index, term, data or None for internal/empty)])
+    messages: List[Tuple[int, Message]]
+    must_sync: bool
+
+    def contains_updates(self) -> bool:
+        return bool(
+            self.hardstates or self.entries or self.snapshots
+            or self.committed or self.messages
+        )
+
+
+class BatchedRawNode:
+    """Thread-safe staging + single-threaded advance_round/advance.
+
+    ``advance_round()`` runs one device round over the staged inputs and
+    produces a BatchedReady; the caller persists/applies/sends, then
+    calls ``advance()`` to commit the host mirrors. Only one
+    round may be in flight at a time.
+    """
+
+    def __init__(
+        self,
+        cfg: BatchedConfig,
+        groups: Optional[np.ndarray] = None,
+        slots: Optional[np.ndarray] = None,
+        restore: Optional[Dict[int, RowRestore]] = None,
+        start_index: int = 0,
+    ) -> None:
+        self.cfg = cfg
+        r = cfg.num_replicas
+        if groups is None:  # dense all-replica layout
+            n = cfg.num_instances
+            groups = np.arange(n, dtype=np.int32) // r
+            slots = np.arange(n, dtype=np.int32) % r
+        else:
+            groups = np.asarray(groups, np.int32)
+            slots = np.asarray(slots, np.int32)
+        self.groups = groups
+        self.slots = slots
+        self.n = len(groups)
+        iids = groups * r + slots
+        self._step = make_step_round(
+            cfg, iids=jnp.asarray(iids), slots=jnp.asarray(slots),
+            with_aux=True,
+        )
+
+        self.state = init_state(cfg, start_index, iids=jnp.asarray(iids))
+        # Host mirrors (updated in advance()).
+        self.m_term = np.zeros(self.n, np.int64)
+        self.m_vote = np.zeros(self.n, np.int64)
+        self.m_commit = np.full(self.n, start_index, np.int64)
+        self.m_last = np.full(self.n, start_index, np.int64)
+        self.m_snap = np.full(self.n, start_index, np.int64)
+        self.m_role = np.zeros(self.n, np.int64)
+        self.m_lead = np.zeros(self.n, np.int64)
+        self.m_ring = np.zeros((self.n, cfg.window), np.int64)
+        self.applied = np.full(self.n, start_index, np.int64)
+        self.stable = np.full(self.n, start_index, np.int64)
+
+        # Payload arena: per row, index -> (term, data).
+        self.arena: List[Dict[int, Tuple[int, bytes]]] = [
+            {} for _ in range(self.n)
+        ]
+
+        # Monotone commit watermark guarding arena immutability (see
+        # step(): inbound MsgApp must not overwrite committed payloads).
+        self._commit_guard = np.full(self.n, start_index, np.int64)
+
+        # Staging (guarded by _lock).
+        self._lock = threading.Lock()
+        self._pending: Dict[Tuple[int, int, int], deque] = {}
+        self._props: List[deque] = [deque() for _ in range(self.n)]
+        self._ticks = np.zeros(self.n, np.int64)
+        self._campaign = np.zeros(self.n, bool)
+        self._isolate = np.zeros(self.n, bool)
+        self._snap_staged: Dict[int, Tuple[int, int]] = {}  # row->(idx,term)
+
+        if restore:
+            self._restore(restore)
+
+        # In-flight round (between advance_round and advance).
+        self._round: Optional[Tuple] = None
+
+    # -- boot ------------------------------------------------------------------
+
+    def _restore(self, restore: Dict[int, RowRestore]) -> None:
+        """Rebuild device state from per-row WAL replay results."""
+        cfg = self.cfg
+        w = cfg.window
+        term = np.zeros(self.n, np.int32)
+        vote = np.zeros(self.n, np.int32)
+        commit = np.zeros(self.n, np.int32)
+        last = np.zeros(self.n, np.int32)
+        snap_i = np.zeros(self.n, np.int32)
+        snap_t = np.zeros(self.n, np.int32)
+        ring = np.zeros((self.n, w), np.int32)
+        for row, rr in restore.items():
+            term[row] = rr.term
+            vote[row] = rr.vote
+            commit[row] = rr.commit
+            snap_i[row] = rr.snap_index
+            snap_t[row] = rr.snap_term
+            li = rr.snap_index
+            for idx, t, data in rr.entries:
+                ring[row, idx % w] = t
+                self.arena[row][idx] = (t, data)
+                li = idx
+            last[row] = li
+            self.applied[row] = rr.applied
+        st = self.state
+        self.state = st._replace(
+            term=jnp.asarray(term),
+            vote=jnp.asarray(vote),
+            commit=jnp.asarray(commit),
+            last=jnp.asarray(last),
+            snap_index=jnp.asarray(snap_i),
+            snap_term=jnp.asarray(snap_t),
+            log_term=jnp.asarray(ring),
+            next=jnp.repeat(
+                jnp.asarray(last)[:, None] + 1, cfg.num_replicas, axis=1
+            ),
+        )
+        self.m_term = term.astype(np.int64)
+        self.m_vote = vote.astype(np.int64)
+        self.m_commit = commit.astype(np.int64)
+        self.m_last = last.astype(np.int64)
+        self.m_snap = snap_i.astype(np.int64)
+        self.m_ring = ring.astype(np.int64)
+        self.stable = last.astype(np.int64)
+        self._commit_guard = np.maximum(
+            self._commit_guard, commit.astype(np.int64)
+        )
+
+    # -- staging ---------------------------------------------------------------
+
+    def tick(self, rows: Optional[np.ndarray] = None) -> None:
+        with self._lock:
+            if rows is None:
+                self._ticks += 1
+            else:
+                self._ticks[rows] += 1
+
+    def campaign(self, rows) -> None:
+        with self._lock:
+            self._campaign[rows] = True
+
+    def isolate(self, rows, on: bool = True) -> None:
+        """Fault injection: cut rows off the network."""
+        with self._lock:
+            self._isolate[rows] = on
+
+    def propose(self, row: int, data: bytes) -> None:
+        """Queue a payload; it is appended (and assigned an index) in a
+        round where this row is leader. Callers that need follower
+        forwarding do it above this layer (see batched/node.py)."""
+        with self._lock:
+            self._props[row].append(data)
+
+    def pending_proposals(self, row: int) -> int:
+        with self._lock:
+            return len(self._props[row])
+
+    def step(self, row: int, m: Message) -> None:
+        """Stage an inbound wire message for `row`. MsgApp entry
+        payloads go to the arena; MsgSnap app-state restore must already
+        have happened (hosting layer) — here we stage the device-side
+        ring restore."""
+        t = int(m.type)
+        lane = _LANE.get(t)
+        if lane is None:
+            raise ValueError(f"unroutable message type {m.type!r}")
+        from_slot = m.from_ - 1
+        if t == T_APP:
+            with self._lock:
+                ar = self.arena[row]
+                for e in m.entries:
+                    # Never clobber a committed entry's payload with a
+                    # conflicting (necessarily stale) one — committed
+                    # entries are immutable; only fill gaps there
+                    # (post-snapshot resends).
+                    if e.index > self._commit_guard[row] or e.index not in ar:
+                        ar[e.index] = (e.term, e.data)
+        if t == T_SNAP and m.index == 0:
+            # Device ring-floor metadata normally rides in index/log_term
+            # (the app snapshot in m.snapshot may sit at a HIGHER applied
+            # index); fall back to the snapshot metadata when a caller
+            # only filled the Snapshot (host-raft senders).
+            m = Message(
+                type=m.type, to=m.to, from_=m.from_, term=m.term,
+                log_term=m.snapshot.metadata.term,
+                index=m.snapshot.metadata.index,
+            )
+        with self._lock:
+            self._pending.setdefault((row, from_slot, lane), deque()).append(m)
+
+    def install_snapshot_state(self, row: int, index: int,
+                               applied_data_restored: bool = True) -> None:
+        """Hosting layer notifies that app state for `row` was restored
+        at `index` (from an inbound snapshot): advance the host applied
+        watermark and drop arena entries at/below it."""
+        with self._lock:
+            if index > self.applied[row]:
+                self.applied[row] = index
+            ar = self.arena[row]
+            for i in [i for i in ar if i <= index]:
+                del ar[i]
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(
+                self._pending
+                or self._ticks.any()
+                or self._campaign.any()
+                or any(self._props[i] and self.m_role[i] == LEADER
+                       for i in range(self.n))
+            )
+
+    # -- the round -------------------------------------------------------------
+
+    def advance_round(self) -> BatchedReady:
+        assert self._round is None, "previous round not advanced"
+        cfg = self.cfg
+        r, e, w = cfg.num_replicas, cfg.max_ents_per_msg, cfg.window
+
+        with self._lock:
+            inbox, consumed = self._build_inbox()
+            ticks = self._ticks > 0
+            self._ticks = np.maximum(self._ticks - 1, 0)
+            camp = self._campaign.copy()
+            self._campaign[:] = False
+            iso = self._isolate.copy()
+            props_n = np.fromiter(
+                (min(len(q), cfg.max_props_per_round) for q in self._props),
+                np.int32, count=self.n,
+            )
+
+        st, outbox, aux = self._step(
+            self.state, inbox,
+            jnp.asarray(ticks), jnp.asarray(camp),
+            jnp.asarray(props_n), jnp.asarray(iso),
+        )
+        self.state = st
+
+        # One bulk device→host transfer.
+        (term, vote, commit, last, role, lead, snap_i, snap_t, ring,
+         last_tick) = jax.device_get([
+            st.term, st.vote, st.commit, st.last, st.role, st.lead,
+            st.snap_index, st.snap_term, st.log_term,
+            aux.last_tick,
+        ])
+        out_np = jax.device_get(outbox)
+
+        term = term.astype(np.int64)
+        vote = vote.astype(np.int64)
+        commit = commit.astype(np.int64)
+        last = last.astype(np.int64)
+        ring64 = ring.astype(np.int64)
+
+        # Everything below reads/writes the arena, so it runs under
+        # _lock: inbound transport threads (step) must neither clobber
+        # payloads mid-drain nor observe half-assigned proposals.
+        with self._lock:
+            # Freeze arena immutability at this round's commit BEFORE
+            # reading payloads out (see step()'s _commit_guard check).
+            self._commit_guard = np.maximum(self._commit_guard, commit)
+
+            # -- proposals: pop exactly as many as the device appended
+            # and assign their indexes (the propose phase spans
+            # (last_tick, last]).
+            for row in np.nonzero(last > last_tick)[0]:
+                q = self._props[row]
+                n_app = int(last[row] - last_tick[row])
+                base = int(last_tick[row])
+                for j in range(n_app):
+                    data = q.popleft()
+                    self.arena[row][base + 1 + j] = (int(term[row]), data)
+
+            # -- entry records to persist: contiguous (fc-1, last] where
+            # fc is the first ring-changed index this round (or stable+1).
+            entries: List[Tuple[int, int, int, bytes]] = []
+            snapshots: List[Tuple[int, int, int]] = []
+            restored = np.zeros(self.n, bool)
+            for row in range(self.n):
+                if snap_i[row] > self.m_last[row]:
+                    # Device installed a snapshot past our old log: ring
+                    # floor jumped. Record it; entries beyond follow.
+                    snapshots.append(
+                        (row, int(snap_i[row]), int(snap_t[row]))
+                    )
+                    restored[row] = True
+            changed = ring64 != self.m_ring
+            rows_changed = np.nonzero(
+                changed.any(axis=1) | (last > self.stable) | restored
+            )[0]
+            for row in rows_changed:
+                lo = int(self.stable[row]) + 1
+                pos = np.nonzero(changed[row])[0]
+                if len(pos):
+                    li = int(last[row])
+                    idxs = li - ((li - pos) % w)
+                    idxs = idxs[idxs > snap_i[row]]
+                    if len(idxs):
+                        lo = min(lo, int(idxs.min()))
+                lo = max(lo, int(snap_i[row]) + 1)
+                for i in range(lo, int(last[row]) + 1):
+                    t = int(ring64[row, i % w])
+                    ar = self.arena[row].get(i)
+                    data = ar[1] if ar is not None and ar[0] == t else b""
+                    entries.append((row, i, t, data))
+
+            # -- hardstate deltas
+            hardstates = [
+                (int(row), int(term[row]), int(vote[row]), int(commit[row]))
+                for row in np.nonzero(
+                    (term != self.m_term) | (vote != self.m_vote)
+                    | (commit != self.m_commit)
+                )[0]
+            ]
+
+            # -- committed ranges (applied, commit]
+            committed: List[
+                Tuple[int, List[Tuple[int, int, Optional[bytes]]]]
+            ] = []
+            for row in np.nonzero(commit > self.applied)[0]:
+                lo = max(int(self.applied[row]), int(snap_i[row]))
+                items: List[Tuple[int, int, Optional[bytes]]] = []
+                for i in range(lo + 1, int(commit[row]) + 1):
+                    t = int(ring64[row, i % w])
+                    ar = self.arena[row].get(i)
+                    data = (
+                        ar[1] if ar is not None and ar[0] == t and ar[1]
+                        else None
+                    )
+                    items.append((i, t, data))
+                if items:
+                    committed.append((int(row), items))
+
+            # -- outbound messages (MsgApp payloads come from the arena)
+            messages = self._collect_messages(
+                out_np, ring64, snap_i, last, term, commit
+            )
+
+        must_sync = bool(
+            entries
+            or any(
+                term[row] != self.m_term[row] or vote[row] != self.m_vote[row]
+                for row, *_ in hardstates
+            )
+        )
+
+        self._round = (term, vote, commit, last, role, lead,
+                       snap_i.astype(np.int64), ring64)
+        return BatchedReady(
+            hardstates=hardstates,
+            entries=entries,
+            snapshots=snapshots,
+            committed=committed,
+            messages=messages,
+            must_sync=must_sync,
+        )
+
+    def advance(self) -> None:
+        """Confirm the last Ready: host mirrors move to the new state
+        (ref: rawnode.go:174-179 Advance)."""
+        assert self._round is not None
+        (term, vote, commit, last, role, lead, snap_i, ring64) = self._round
+        with self._lock:
+            # Under _lock: transport threads mutate self.applied via
+            # install_snapshot_state, and read the mirrors.
+            self.m_term, self.m_vote, self.m_commit = term, vote, commit
+            self.m_last, self.m_role, self.m_lead = last, role, lead
+            self.m_snap, self.m_ring = snap_i, ring64
+            self.applied = np.maximum(self.applied, commit)
+            self.stable = last.copy()
+            # GC arena below the compaction floor.
+            for row in range(self.n):
+                fl = int(min(self.applied[row], snap_i[row]))
+                ar = self.arena[row]
+                if len(ar) > 2 * self.cfg.window:
+                    for i in [i for i in ar if i <= fl]:
+                        del ar[i]
+            self._round = None
+
+    # -- internals -------------------------------------------------------------
+
+    def _build_inbox(self):
+        """Pop at most one pending message per (row, sender, lane) into
+        a dense inbox. Caller holds _lock."""
+        cfg = self.cfg
+        r, e = cfg.num_replicas, cfg.max_ents_per_msg
+        shape = (self.n, r, NUM_KINDS)
+        valid = np.zeros(shape, bool)
+        typ = np.zeros(shape, np.int32)
+        term = np.zeros(shape, np.int32)
+        log_term = np.zeros(shape, np.int32)
+        index = np.zeros(shape, np.int32)
+        commit = np.zeros(shape, np.int32)
+        reject = np.zeros(shape, bool)
+        reject_hint = np.zeros(shape, np.int32)
+        n_ents = np.zeros(shape, np.int32)
+        ent_terms = np.zeros(shape + (e,), np.int32)
+        consumed = 0
+        dead = []
+        for key, q in self._pending.items():
+            row, s, lane = key
+            m: Message = q.popleft()
+            consumed += 1
+            if not q:
+                dead.append(key)
+            valid[row, s, lane] = True
+            typ[row, s, lane] = int(m.type)
+            term[row, s, lane] = m.term
+            log_term[row, s, lane] = m.log_term
+            index[row, s, lane] = m.index
+            commit[row, s, lane] = m.commit
+            reject[row, s, lane] = m.reject
+            reject_hint[row, s, lane] = m.reject_hint
+            n_ents[row, s, lane] = len(m.entries)
+            for j, ent in enumerate(m.entries[:e]):
+                ent_terms[row, s, lane, j] = ent.term
+        for key in dead:
+            del self._pending[key]
+        inbox = MsgSlots(
+            valid=jnp.asarray(valid), type=jnp.asarray(typ),
+            term=jnp.asarray(term), log_term=jnp.asarray(log_term),
+            index=jnp.asarray(index), commit=jnp.asarray(commit),
+            reject=jnp.asarray(reject), reject_hint=jnp.asarray(reject_hint),
+            n_ents=jnp.asarray(n_ents), ent_terms=jnp.asarray(ent_terms),
+        )
+        return inbox, consumed
+
+    def _collect_messages(self, out, ring64, snap_i, last, term, commit):
+        """outbox slots → Message objects (payloads re-attached)."""
+        w = self.cfg.window
+        msgs: List[Tuple[int, Message]] = []
+        rows, targets, kinds = np.nonzero(np.asarray(out.valid))
+        for row, tgt, k in zip(rows, targets, kinds):
+            t = int(out.type[row, tgt, k])
+            m = Message(
+                type=MessageType(t),
+                to=int(tgt) + 1,
+                from_=int(self.slots[row]) + 1,
+                term=int(out.term[row, tgt, k]),
+                log_term=int(out.log_term[row, tgt, k]),
+                index=int(out.index[row, tgt, k]),
+                commit=int(out.commit[row, tgt, k]),
+                reject=bool(out.reject[row, tgt, k]),
+                reject_hint=int(out.reject_hint[row, tgt, k]),
+            )
+            ne = int(out.n_ents[row, tgt, k])
+            if t == T_APP and ne:
+                ents = []
+                for j in range(ne):
+                    idx = m.index + 1 + j
+                    et = int(out.ent_terms[row, tgt, k, j])
+                    ar = self.arena[row].get(idx)
+                    data = b"" if ar is None or ar[0] != et else ar[1]
+                    ents.append(Entry(index=idx, term=et, data=data))
+                m.entries = ents
+            elif t == T_SNAP:
+                # metadata only; the hosting layer attaches app data
+                # (at its applied watermark ≥ this floor) before the
+                # wire (see hosting.py / node.py).
+                m.snapshot = Snapshot(
+                    metadata=SnapshotMetadata(
+                        index=int(out.index[row, tgt, k]),
+                        term=int(out.log_term[row, tgt, k]),
+                    )
+                )
+            msgs.append((int(row), m))
+        return msgs
+
+    # -- introspection ---------------------------------------------------------
+
+    def latest_ring(self) -> np.ndarray:
+        """The newest known [n, W] term ring (in-flight round if any)."""
+        return self._round[7] if self._round is not None else self.m_ring
+
+    def latest_commit(self, row: int) -> int:
+        arr = self._round[2] if self._round is not None else self.m_commit
+        return int(arr[row])
+
+    def compact(self, row: int, index: int) -> None:
+        """Move the device ring floor to `index` (host took an app
+        snapshot there). Safe mid-Ready: the floor only rises, and
+        advance() merges it with np.maximum."""
+        idx = int(min(index, self.latest_commit(row)))
+        cur = (self._round[6] if self._round is not None else self.m_snap)
+        if idx <= int(cur[row]):
+            return
+        t = int(self.latest_ring()[row, idx % self.cfg.window])
+        st = self.state
+        self.state = st._replace(
+            snap_index=st.snap_index.at[row].set(idx),
+            snap_term=st.snap_term.at[row].set(t),
+        )
+        self.m_snap[row] = max(self.m_snap[row], idx)
+        if self._round is not None:
+            self._round[6][row] = max(self._round[6][row], idx)
+
+    def leader_rows(self) -> np.ndarray:
+        return np.nonzero(self.m_role == LEADER)[0]
+
+    def is_leader(self, row: int) -> bool:
+        return self.m_role[row] == LEADER
+
+    def lead(self, row: int) -> int:
+        """Leader member id (slot+1) as known by `row`, 0 if unknown."""
+        return int(self.m_lead[row])

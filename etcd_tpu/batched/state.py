@@ -109,11 +109,22 @@ def instance_slot(cfg: BatchedConfig) -> jnp.ndarray:
     return jnp.asarray(_slot_ids(cfg))
 
 
-def init_state(cfg: BatchedConfig, start_index: int = 0) -> BatchedState:
+def init_state(cfg: BatchedConfig, start_index: int = 0,
+               iids=None) -> BatchedState:
     """All groups bootstrapped as followers at term 0 with R voters, log
     beginning at start_index (mirrors add-nodes bootstrap-from-snapshot,
-    ref: rafttest/interaction_env_handler_add_nodes.go)."""
-    n, r, w = cfg.num_instances, cfg.num_replicas, cfg.window
+    ref: rafttest/interaction_env_handler_add_nodes.go).
+
+    `iids` (optional) gives each row its global instance id
+    (group*R + slot): a hosting process that owns one replica slot of
+    every group passes its own subset so the deterministic
+    randomized-timeout hash matches the dense all-replica layout."""
+    r, w = cfg.num_replicas, cfg.window
+    if iids is None:
+        iids = jnp.arange(cfg.num_instances, dtype=I32)
+    else:
+        iids = jnp.asarray(iids, I32)
+    n = iids.shape[0]
     zeros_n = jnp.zeros((n,), I32)
     start = jnp.full((n,), start_index, I32)
     st = BatchedState(
@@ -133,9 +144,7 @@ def init_state(cfg: BatchedConfig, start_index: int = 0) -> BatchedState:
         # 0 of the deterministic hash) — a uniform value would make
         # every boot election a guaranteed split vote.
         randomized_timeout=cfg.election_timeout
-        + (
-            (jnp.arange(n, dtype=I32) + 1) * 7919 % cfg.election_timeout
-        ),
+        + ((iids + 1) * 7919 % cfg.election_timeout),
         reset_count=zeros_n,
         match=jnp.zeros((n, r), I32),
         next=jnp.ones((n, r), I32) * (start[:, None] + 1),

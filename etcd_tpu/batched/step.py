@@ -833,19 +833,25 @@ def route(cfg: BatchedConfig, outbox: MsgSlots) -> MsgSlots:
     return inbox
 
 
-def make_step_round(cfg: BatchedConfig):
-    """Build the jitted round function:
+class StepAux(NamedTuple):
+    """Per-instance log watermark captured after the tick phase (just
+    before proposals append): the host assigns its queued proposal
+    payloads to indexes (last_tick, last] — which is what keeps payload
+    bytes off the device (ref: SURVEY.md §7 "payload bytes don't belong
+    on the TPU")."""
 
-        state, outbox = step_round(state, inbox, tick_mask, campaign_mask,
-                                   propose_n)
+    last_tick: jnp.ndarray  # [N] last log index pre-propose
 
-    All arrays stay on device; chain with route() for a closed-loop
-    multi-raft simulation."""
-    iids = jnp.arange(cfg.num_instances, dtype=I32)
-    slots = iids % cfg.num_replicas
+
+@functools.lru_cache(maxsize=None)
+def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
+    """One jitted round program per config — shared by every engine/
+    node with the same config, whatever rows it hosts (iids/slots are
+    runtime arguments, so three hosting processes' nodes reuse one
+    compilation per shape)."""
 
     def step_round(st: BatchedState, inbox: MsgSlots, tick_mask, campaign_mask,
-                   propose_n, isolate):
+                   propose_n, isolate, iids, slots):
         def per_instance(iid, slot, sti, inbox_i, do_tick, do_camp, n_new,
                          iso):
             # Partitioned instances neither receive nor send this round
@@ -853,6 +859,7 @@ def make_step_round(cfg: BatchedConfig):
             inbox_i = inbox_i._replace(valid=inbox_i.valid & ~iso)
             sti, req_resps = _deliver_all(cfg, iid, slot, sti, inbox_i)
             sti = _tick(cfg, iid, slot, sti, do_tick, do_camp)
+            last_tick = sti.last
             sti = _propose(cfg, slot, sti, n_new)
             sti, out = _emit(cfg, slot, sti)
             # Responses to requests from sender s (kinds 0..2) land in
@@ -861,11 +868,43 @@ def make_step_round(cfg: BatchedConfig):
                 lambda o, rr: o.at[:, 3:].set(rr), out, req_resps
             )
             out = out._replace(valid=out.valid & ~iso)
-            return sti, out
+            return sti, out, StepAux(last_tick)
 
-        return jax.vmap(per_instance)(
+        sti, out, aux = jax.vmap(per_instance)(
             iids, slots, st, inbox, tick_mask, campaign_mask, propose_n,
             isolate,
         )
+        if with_aux:
+            return sti, out, aux
+        return sti, out
 
     return jax.jit(step_round)
+
+
+def make_step_round(cfg: BatchedConfig, iids=None, slots=None,
+                    with_aux: bool = False):
+    """Build the round function:
+
+        state, outbox[, aux] = step_round(state, inbox, tick_mask,
+                                          campaign_mask, propose_n, isolate)
+
+    All arrays stay on device; chain with route() for a closed-loop
+    multi-raft simulation (the dense all-replica layout), or pass
+    explicit `iids`/`slots` for a hosting process that owns one replica
+    slot of each group (iid = group*R + slot keeps the deterministic
+    randomized-timeout hash identical across topologies)."""
+    if iids is None:
+        iids = jnp.arange(cfg.num_instances, dtype=I32)
+    else:
+        iids = jnp.asarray(iids, I32)
+    if slots is None:
+        slots = iids % cfg.num_replicas
+    else:
+        slots = jnp.asarray(slots, I32)
+    inner = _step_round_jit(cfg, with_aux)
+
+    def step(st, inbox, tick_mask, campaign_mask, propose_n, isolate):
+        return inner(st, inbox, tick_mask, campaign_mask, propose_n,
+                     isolate, iids, slots)
+
+    return step

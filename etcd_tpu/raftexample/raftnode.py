@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..batched.node import BatchedNode
+from ..batched.rawnode import RowRestore
 from ..raft.node import Node, Peer
 from ..raft.raft import Config, NONE, StateType
 from ..raft.rawnode import Ready
@@ -64,7 +66,15 @@ class ExampleRaftNode:
         tick_interval: float = 0.05,
         election_tick: int = 10,
         heartbeat_tick: int = 1,
+        backend: str = "host",
     ) -> None:
+        """`backend` selects the raft implementation at this single
+        construction site (ref: etcdserver/bootstrap.go:473-536
+        bootstrapRaft): "host" = the reference-shaped Python core,
+        "tpu" = the batched device engine behind the same Node
+        contract (batched/node.py)."""
+        assert backend in ("host", "tpu"), backend
+        self.backend = backend
         self.id = node_id
         self.peers = list(peers)
         self.network = network
@@ -87,23 +97,42 @@ class ExampleRaftNode:
         self._stopped = threading.Event()
 
         old_wal = WAL.exists(self.wal_dir)
+        self._restore_data = None  # set by _replay for the tpu backend
         self._replay()
 
-        cfg = Config(
-            id=node_id,
-            election_tick=election_tick,
-            heartbeat_tick=heartbeat_tick,
-            storage=self.raft_storage,
-            max_size_per_msg=1024 * 1024,
-            max_inflight_msgs=256,
-            max_uncommitted_entries_size=1 << 30,
-            check_quorum=True,
-            pre_vote=True,
-        )
-        if old_wal or join:
-            self.node = Node.restart(cfg)
+        if backend == "tpu":
+            # Device ring must cover the un-snapshotted tail: snapshots
+            # (and the host-driven ring compaction that follows them)
+            # happen every `snap_count` entries, so size the window past
+            # that plus catch-up margin.
+            window = 1 << max(6, (2 * snap_count + 64).bit_length())
+            window = min(window, 1 << 15)
+            self.snap_count = min(snap_count, window // 4)
+            self.node = BatchedNode(
+                node_id=node_id,
+                peers=peers,
+                election_tick=election_tick,
+                heartbeat_tick=heartbeat_tick,
+                window=window,
+                restore=self._restore_data,
+            )
+            self._restore_data = None
         else:
-            self.node = Node.start(cfg, [Peer(id=p) for p in peers])
+            cfg = Config(
+                id=node_id,
+                election_tick=election_tick,
+                heartbeat_tick=heartbeat_tick,
+                storage=self.raft_storage,
+                max_size_per_msg=1024 * 1024,
+                max_inflight_msgs=256,
+                max_uncommitted_entries_size=1 << 30,
+                check_quorum=True,
+                pre_vote=True,
+            )
+            if old_wal or join:
+                self.node = Node.restart(cfg)
+            else:
+                self.node = Node.start(cfg, [Peer(id=p) for p in peers])
 
         self.storage = ServerStorage(self.wal, self.snapshotter)
         network.register(node_id, self._receive)
@@ -137,6 +166,22 @@ class ExampleRaftNode:
                 self.restore_fn(snap.data)
             self.raft_storage.set_hard_state(hs)
             self.raft_storage.append(ents)
+            base = snap.metadata.index
+            if self.backend != "tpu":
+                return
+            self._restore_data = RowRestore(
+                term=hs.term,
+                vote=hs.vote,
+                commit=hs.commit,
+                applied=base,
+                snap_index=base,
+                snap_term=snap.metadata.term,
+                entries=[
+                    (e.index, e.term, e.data)
+                    for e in ents
+                    if e.index > base
+                ],
+            )
         else:
             self.wal = WAL.create(
                 self.wal_dir, metadata=self.id.to_bytes(8, "big")
@@ -160,9 +205,10 @@ class ExampleRaftNode:
             self.storage.save_snap(rd.snapshot)
         self.wal.save(rd.hard_state, rd.entries, rd.must_sync)
         if not is_empty_snap(rd.snapshot):
-            self.raft_storage.apply_snapshot(rd.snapshot)
+            if self.backend == "host":
+                self.raft_storage.apply_snapshot(rd.snapshot)
             self._publish_snapshot(rd.snapshot)
-        if rd.entries:
+        if rd.entries and self.backend == "host":
             self.raft_storage.append(rd.entries)
         self.network.send(self.id, rd.messages)
         ok = self._publish_entries(self._entries_to_apply(rd.committed_entries))
@@ -228,17 +274,24 @@ class ExampleRaftNode:
         if self.applied_index - self.snapshot_index <= self.snap_count:
             return
         data = self.snapshot_fn()
-        snap = self.raft_storage.create_snapshot(
-            self.applied_index, self.confstate, data
-        )
-        self.storage.save_snap(snap)
-        compact_index = 1
-        if self.applied_index > SNAPSHOT_CATCHUP_ENTRIES:
-            compact_index = self.applied_index - SNAPSHOT_CATCHUP_ENTRIES
-        try:
-            self.raft_storage.compact(compact_index)
-        except Exception:  # noqa: BLE001 — already compacted is fine
-            pass
+        if self.backend == "tpu":
+            snap = self.node.create_snapshot(
+                self.applied_index, self.confstate, data
+            )
+            self.storage.save_snap(snap)
+            self.node.compact(self.applied_index, snap)
+        else:
+            snap = self.raft_storage.create_snapshot(
+                self.applied_index, self.confstate, data
+            )
+            self.storage.save_snap(snap)
+            compact_index = 1
+            if self.applied_index > SNAPSHOT_CATCHUP_ENTRIES:
+                compact_index = self.applied_index - SNAPSHOT_CATCHUP_ENTRIES
+            try:
+                self.raft_storage.compact(compact_index)
+            except Exception:  # noqa: BLE001 — already compacted is fine
+                pass
         self.storage.release(snap)
         self.snapshot_index = self.applied_index
 

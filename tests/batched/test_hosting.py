@@ -1,0 +1,127 @@
+"""Multi-raft hosting layer: G groups served by R members over the
+batched device engine, with a shared native WAL and per-group KV apply
+(the SURVEY §7 steps 4-6 slice: host runtime over the TPU backend)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from etcd_tpu.batched.hosting import (
+    GroupKV,
+    MultiRaftCluster,
+    MultiRaftMember,
+)
+from etcd_tpu.batched.state import BatchedConfig
+
+
+def wait_until(pred, timeout=20.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+G = 16
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = MultiRaftCluster(str(tmp_path), num_members=3, num_groups=G)
+    yield c
+    c.stop()
+
+
+class TestMultiRaftHosting:
+    def test_every_group_elects_and_replicates(self, cluster):
+        leads = cluster.wait_leaders()
+        assert (leads > 0).all()
+        for g in range(0, G, 3):
+            cluster.put(g, b"k", b"v%d" % g)
+        # Replicated to every member's applied state.
+        for g in range(0, G, 3):
+            for m in cluster.members.values():
+                wait_until(
+                    lambda m=m, g=g: m.get(g, b"k") == b"v%d" % g,
+                    msg=f"group {g} on member {m.id}",
+                )
+
+    def test_quorum_survives_member_loss(self, cluster):
+        cluster.wait_leaders()
+        cluster.put(0, b"a", b"1")
+        victim = 3
+        cluster.router.isolate(victim)
+        # Groups led by the victim re-elect among survivors.
+        t0 = time.monotonic()
+        cluster.put(0, b"b", b"2", timeout=30.0)
+        cluster.put(5, b"c", b"3", timeout=30.0)
+        survivors = [m for mid, m in cluster.members.items() if mid != victim]
+        for m in survivors:
+            wait_until(lambda m=m: m.get(5, b"c") == b"3",
+                       msg=f"member {m.id} catches up")
+        # Healed member converges.
+        cluster.router.heal(victim)
+        vm = cluster.members[victim]
+        wait_until(lambda: vm.get(5, b"c") == b"3", timeout=30.0,
+                   msg="healed member catch-up")
+
+    def test_wal_restart_recovers_state(self, tmp_path):
+        c = MultiRaftCluster(str(tmp_path), num_members=3, num_groups=G)
+        try:
+            c.wait_leaders()
+            for g in range(G):
+                c.put(g, b"key", b"val%d" % g)
+            for m in c.members.values():
+                wait_until(
+                    lambda m=m: all(
+                        m.get(g, b"key") == b"val%d" % g for g in range(G)
+                    ),
+                    msg=f"full replication on member {m.id}",
+                )
+        finally:
+            c.stop()
+        # Cold restart from the WALs only.
+        c2 = MultiRaftCluster(str(tmp_path), num_members=3, num_groups=G)
+        try:
+            for m in c2.members.values():
+                wait_until(
+                    lambda m=m: all(
+                        m.get(g, b"key") == b"val%d" % g for g in range(G)
+                    ),
+                    timeout=30.0,
+                    msg=f"member {m.id} state after WAL replay",
+                )
+        finally:
+            c2.stop()
+
+    def test_snapshot_catchup_for_lagging_member(self, tmp_path):
+        # Small window forces the ring floor past a lagging member's
+        # log: catch-up must go through the snapshot path (device
+        # T_SNAP + host app-state transfer).
+        cfg = BatchedConfig(
+            num_groups=4, num_replicas=3, window=16, max_ents_per_msg=4,
+            max_props_per_round=4, election_timeout=10, heartbeat_timeout=1,
+            pre_vote=True, check_quorum=True, auto_compact=True,
+        )
+        c = MultiRaftCluster(str(tmp_path), num_members=3, num_groups=4,
+                             cfg=cfg)
+        try:
+            c.wait_leaders()
+            victim = 3
+            c.router.isolate(victim)
+            # Push far more entries than the window holds.
+            for i in range(40):
+                c.put(0, b"k%d" % i, b"v%d" % i, timeout=30.0)
+            c.router.heal(victim)
+            vm = c.members[victim]
+            wait_until(
+                lambda: all(
+                    vm.get(0, b"k%d" % i) == b"v%d" % i for i in range(40)
+                ),
+                timeout=30.0,
+                msg="lagging member catches up via snapshot",
+            )
+        finally:
+            c.stop()

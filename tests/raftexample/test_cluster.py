@@ -21,7 +21,8 @@ def wait_until(pred, timeout=10.0, interval=0.02, msg="condition"):
     raise AssertionError(f"timed out waiting for {msg}")
 
 
-def make_cluster(tmp_path, n=3, net=None, snap_count=10000):
+def make_cluster(tmp_path, n=3, net=None, snap_count=10000,
+                 backend="host"):
     net = net or InProcNetwork()
     peers = list(range(1, n + 1))
     kvs, nodes = {}, {}
@@ -37,10 +38,20 @@ def make_cluster(tmp_path, n=3, net=None, snap_count=10000):
             restore_fn=kv.restore,
             snap_count=snap_count,
             tick_interval=0.01,
+            backend=backend,
         )
         kv.attach(node)
         kvs[nid], nodes[nid] = kv, node
     return net, nodes, kvs
+
+
+@pytest.fixture(params=["host", "tpu"])
+def backend(request):
+    """Every cluster scenario runs on both raft backends — the host
+    core and the batched device engine behind the same Node contract
+    (the SURVEY §7.4 success criterion: raftexample semantics with the
+    TPU backend)."""
+    return request.param
 
 
 def wait_leader(nodes, timeout=10.0):
@@ -66,8 +77,8 @@ def stop_all(net, nodes):
 
 
 class TestThreeNodeCluster:
-    def test_propose_replicates_everywhere(self, tmp_path):
-        net, nodes, kvs = make_cluster(tmp_path)
+    def test_propose_replicates_everywhere(self, tmp_path, backend):
+        net, nodes, kvs = make_cluster(tmp_path, backend=backend)
         try:
             lead = wait_leader(nodes)
             kvs[lead].propose("foo", "bar")
@@ -79,8 +90,8 @@ class TestThreeNodeCluster:
         finally:
             stop_all(net, nodes)
 
-    def test_follower_proposal_forwarded(self, tmp_path):
-        net, nodes, kvs = make_cluster(tmp_path)
+    def test_follower_proposal_forwarded(self, tmp_path, backend):
+        net, nodes, kvs = make_cluster(tmp_path, backend=backend)
         try:
             lead = wait_leader(nodes)
             follower = next(i for i in nodes if i != lead)
@@ -93,8 +104,8 @@ class TestThreeNodeCluster:
         finally:
             stop_all(net, nodes)
 
-    def test_leader_failover(self, tmp_path):
-        net, nodes, kvs = make_cluster(tmp_path)
+    def test_leader_failover(self, tmp_path, backend):
+        net, nodes, kvs = make_cluster(tmp_path, backend=backend)
         try:
             lead = wait_leader(nodes)
             kvs[lead].propose("before", "1")
@@ -119,8 +130,8 @@ class TestThreeNodeCluster:
         finally:
             stop_all(net, nodes)
 
-    def test_restart_replays_wal(self, tmp_path):
-        net, nodes, kvs = make_cluster(tmp_path)
+    def test_restart_replays_wal(self, tmp_path, backend):
+        net, nodes, kvs = make_cluster(tmp_path, backend=backend)
         try:
             lead = wait_leader(nodes)
             for i in range(20):
@@ -142,6 +153,7 @@ class TestThreeNodeCluster:
                 snapshot_fn=kv2.snapshot,
                 restore_fn=kv2.restore,
                 tick_interval=0.01,
+                backend=backend,
             )
             kv2.attach(node2)
             nodes[victim], kvs[victim] = node2, kv2
@@ -153,8 +165,9 @@ class TestThreeNodeCluster:
         finally:
             stop_all(net, nodes)
 
-    def test_snapshot_trigger_and_restore(self, tmp_path):
-        net, nodes, kvs = make_cluster(tmp_path, snap_count=20)
+    def test_snapshot_trigger_and_restore(self, tmp_path, backend):
+        net, nodes, kvs = make_cluster(tmp_path, snap_count=20,
+                                       backend=backend)
         try:
             lead = wait_leader(nodes)
             for i in range(60):
@@ -179,6 +192,7 @@ class TestThreeNodeCluster:
                 restore_fn=kv2.restore,
                 snap_count=20,
                 tick_interval=0.01,
+                backend=backend,
             )
             kv2.attach(node2)
             nodes[victim], kvs[victim] = node2, kv2
