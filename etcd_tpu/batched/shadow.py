@@ -91,13 +91,15 @@ class ShadowCluster:
         iso = set(isolate)
         proposals = proposals or {}
 
-        # Phase 1: deliver, fixed (sender, kind) order per target.
+        # Phase 1: deliver, fixed (kind, sender) order per target — the
+        # device processes lane-by-lane with senders ascending within a
+        # lane (step.py _deliver_all).
         inbox, self.inbox = self.inbox, self._empty_inbox()
         for target in range(self.r):
             if target in iso:
                 continue
-            for sender in range(self.r):
-                for kind in range(NUM_KINDS):
+            for kind in range(NUM_KINDS):
+                for sender in range(self.r):
                     m = inbox[target][sender][kind]
                     if m is None:
                         continue

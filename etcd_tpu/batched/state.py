@@ -47,6 +47,12 @@ class BatchedConfig(NamedTuple):
     # beyond that take the snapshot path (ref: etcdserver's
     # SnapshotCount / CatchUpEntries policy, server.go:73,80).
     auto_compact: bool = False
+    # Run the kernel with the instance axis MINOR ([R, N] / [W, N]
+    # internally): on TPU the (8, 128) vector lanes then fill with the
+    # huge N axis instead of the tiny R/W/K dims. The public layout
+    # stays [N, ...]; the jitted round transposes at entry/exit.
+    # bench.py probes both layouts and picks the faster one per device.
+    lanes_minor: bool = False
 
     @property
     def num_instances(self) -> int:

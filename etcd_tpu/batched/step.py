@@ -244,17 +244,12 @@ def _paused(cfg: BatchedConfig, st: BatchedState):
 # -----------------------------------------------------------------------------
 
 
-def _deliver_one(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
-                 from_slot):
-    """Step one message; returns (state', response MsgSlots scalar-shaped).
-
-    Mirrors raft.Step's term handling then the role step functions
-    (ref: raft.go:847-987, 991-1473)."""
-    no_resp = empty_msgs((), cfg.max_ents_per_msg)
-
-    last_term = term_at(st.log_term, st.snap_index, st.snap_term, st.last, st.last)
-
-    # ---- term handling (ref: raft.go:849-920) ----
+def _term_gate(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
+               from_slot):
+    """raft.Step's term handling (ref: raft.go:849-920), shared by every
+    lane handler. Returns (st1, dead, lower, stale_resp_needed) where
+    st1 is post-become-follower state, `dead` kills the message
+    entirely, `lower` routes to the stale path."""
     higher = m.term > st.term
     lower = m.term < st.term
 
@@ -275,123 +270,164 @@ def _deliver_one(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
         jnp.where(from_leader_type, from_slot + 1, 0),
     )
     st1 = _sel(do_become, st_b, st)
-
-    # Stale-term handling: nudge removed/stale leaders with an empty
-    # MsgAppResp, reject stale pre-votes, ignore the rest.
-    stale_leader_msg = (
-        lower
-        & jnp.asarray(cfg.check_quorum or cfg.pre_vote)
-        & ((m.type == T_HB) | (m.type == T_APP))
-    )
-    stale_prevote = lower & (m.type == T_PREVOTE)
-    # Both stale-path responses carry our (higher) term so the deposed
-    # sender steps down on receipt (the oracle's send() stamps r.Term).
-    resp_stale = no_resp._replace(
-        valid=stale_leader_msg | stale_prevote,
-        type=jnp.where(stale_prevote, T_PREVOTE_RESP, T_APP_RESP),
-        term=st.term,
-        reject=stale_prevote,
-    )
-
-    # ---- main dispatch (on st1, post term handling) ----
-    st_out, resp = _dispatch(cfg, iid, slot, st1, m, from_slot, last_term)
-
     dead = ~m.valid | ignore_lease
-    st_out = _sel(dead, st, _sel(lower, st, st_out))
-    resp = _sel(
-        dead, no_resp, _sel(lower, resp_stale, resp)
-    )
-    return st_out, resp
+    return st1, dead, lower
 
 
-def _dispatch(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
-              from_slot, last_term):
+# -- lane handlers: each processes ONE inbox lane's message for one
+# instance, implementing only the types that can land in that lane
+# (lanes are capacity classes — the specialization is what keeps the
+# per-slot cost low; ref: raft.go:991-1473 step* dispatch).
+
+
+def _lane_vote(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
+               from_slot):
+    """Lane KIND_VOTE: T_VOTE / T_PREVOTE requests (ref: raft.go:930-978)."""
     no_resp = empty_msgs((), cfg.max_ents_per_msg)
-    r = st.match.shape[-1]
-    peers = jnp.arange(r, dtype=I32)
+    st1, dead, lower = _term_gate(cfg, iid, slot, st, m, from_slot)
 
-    # ---- vote requests, any role (ref: raft.go:930-978) ----
-    is_vote_req = (m.type == T_VOTE) | (m.type == T_PREVOTE)
+    last_term = term_at(
+        st1.log_term, st1.snap_index, st1.snap_term, st1.last, st1.last
+    )
     can_vote = (
-        (st.vote == from_slot + 1)
-        | ((st.vote == 0) & (st.lead == 0))
-        | ((m.type == T_PREVOTE) & (m.term > st.term))
+        (st1.vote == from_slot + 1)
+        | ((st1.vote == 0) & (st1.lead == 0))
+        | ((m.type == T_PREVOTE) & (m.term > st1.term))
     )
     up_to_date = (m.log_term > last_term) | (
-        (m.log_term == last_term) & (m.index >= st.last)
+        (m.log_term == last_term) & (m.index >= st1.last)
     )
     grant = can_vote & up_to_date
     resp_type = jnp.where(m.type == T_VOTE, T_VOTE_RESP, T_PREVOTE_RESP)
     vote_resp = no_resp._replace(
-        valid=is_vote_req,
+        valid=True,
         type=resp_type,
-        term=jnp.where(grant, m.term, st.term),
+        term=jnp.where(grant, m.term, st1.term),
         reject=~grant,
     )
     record_real = grant & (m.type == T_VOTE)
-    st_vote = st._replace(
-        election_elapsed=jnp.where(record_real, 0, st.election_elapsed),
-        vote=jnp.where(record_real, from_slot + 1, st.vote),
+    st_vote = st1._replace(
+        election_elapsed=jnp.where(record_real, 0, st1.election_elapsed),
+        vote=jnp.where(record_real, from_slot + 1, st1.vote),
     )
 
-    # ---- candidate receiving leader traffic at own term steps down
-    # (ref: raft.go:1390-1398) ----
-    is_cand = (st.role == CANDIDATE) | (st.role == PRECANDIDATE)
-    from_leader_type = (m.type == T_APP) | (m.type == T_HB) | (m.type == T_SNAP)
+    # Stale pre-vote: reject with our term (deposes the sender).
+    stale_prevote = lower & (m.type == T_PREVOTE)
+    resp_stale = no_resp._replace(
+        valid=stale_prevote,
+        type=jnp.asarray(T_PREVOTE_RESP, I32),
+        term=st.term,
+        reject=True,
+    )
+    st_out = _sel(dead | lower, st, st_vote)
+    resp = _sel(dead, no_resp, _sel(lower, resp_stale, vote_resp))
+    return st_out, resp
+
+
+def _leader_traffic_prelude(cfg, iid, slot, st1, m, from_slot):
+    """Candidate step-down + follower bookkeeping shared by the APP and
+    HB lanes (ref: raft.go:1390-1398, 1433-1444)."""
+    is_cand = (st1.role == CANDIDATE) | (st1.role == PRECANDIDATE)
     st_f = _sel(
-        is_cand & from_leader_type,
-        _become_follower(cfg, st, iid, slot, m.term, from_slot + 1),
-        st,
+        is_cand,
+        _become_follower(cfg, st1, iid, slot, m.term, from_slot + 1),
+        st1,
     )
-
-    # ---- follower: MsgApp / MsgHeartbeat / MsgSnap (ref: raft.go:1433-1444) ----
-    fol = st_f._replace(
-        election_elapsed=jnp.zeros_like(st.election_elapsed),
+    return st_f._replace(
+        election_elapsed=jnp.zeros_like(st1.election_elapsed),
         lead=from_slot + 1,
     )
+
+
+def _lane_app(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
+              from_slot):
+    """Lane KIND_APP: T_APP / T_SNAP (ref: raft.go:1475-1614)."""
+    no_resp = empty_msgs((), cfg.max_ents_per_msg)
+    st1, dead, lower = _term_gate(cfg, iid, slot, st, m, from_slot)
+
+    fol = _leader_traffic_prelude(cfg, iid, slot, st1, m, from_slot)
     st_app, app_resp = _handle_append(cfg, fol, m)
+    st_snap, snap_resp = _handle_snapshot(cfg, fol, m)
+    is_snap = m.type == T_SNAP
+    leader_traffic_ok = st1.role != LEADER
+    st_live = _sel(is_snap, st_snap, st_app)
+    resp_live = _sel(is_snap, snap_resp, app_resp)
+    st_live = _sel(leader_traffic_ok, st_live, st1)
+    resp_live = _sel(leader_traffic_ok, resp_live, no_resp)
+
+    # Stale leader: nudge with an empty MsgAppResp carrying our term
+    # (ref: raft.go:885-905).
+    stale = lower & jnp.asarray(cfg.check_quorum or cfg.pre_vote)
+    resp_stale = no_resp._replace(
+        valid=stale, type=jnp.asarray(T_APP_RESP, I32), term=st.term
+    )
+    st_out = _sel(dead | lower, st, st_live)
+    resp = _sel(dead, no_resp, _sel(lower, resp_stale, resp_live))
+    return st_out, resp
+
+
+def _lane_hb(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
+             from_slot):
+    """Lane KIND_HB: T_HB (ref: raft.go:1513)."""
+    no_resp = empty_msgs((), cfg.max_ents_per_msg)
+    st1, dead, lower = _term_gate(cfg, iid, slot, st, m, from_slot)
+
+    fol = _leader_traffic_prelude(cfg, iid, slot, st1, m, from_slot)
     st_hb = fol._replace(
         commit=jnp.maximum(fol.commit, jnp.minimum(m.commit, fol.last))
     )
     hb_resp = no_resp._replace(
         valid=True, type=jnp.asarray(T_HB_RESP, I32), term=fol.term
     )
-    st_snap, snap_resp = _handle_snapshot(cfg, fol, m)
+    leader_traffic_ok = st1.role != LEADER
+    st_live = _sel(leader_traffic_ok, st_hb, st1)
+    resp_live = _sel(leader_traffic_ok, hb_resp, no_resp)
 
-    # Only followers-or-demoted-candidates take the leader-traffic path;
-    # a leader at the same term can't coexist, but mask anyway.
-    leader_traffic_ok = st.role != LEADER
+    stale = lower & jnp.asarray(cfg.check_quorum or cfg.pre_vote)
+    resp_stale = no_resp._replace(
+        valid=stale, type=jnp.asarray(T_APP_RESP, I32), term=st.term
+    )
+    st_out = _sel(dead | lower, st, st_live)
+    resp = _sel(dead, no_resp, _sel(lower, resp_stale, resp_live))
+    return st_out, resp
 
-    # ---- leader: MsgAppResp / MsgHeartbeatResp (ref: raft.go:1106-1309) ----
-    st_ar = _leader_app_resp(cfg, st, m, from_slot)
-    st_hr = _leader_hb_resp(cfg, st, m, from_slot)
-    is_leader = st.role == LEADER
 
-    # ---- candidate: vote responses (ref: raft.go:1399-1414) ----
-    my_resp_type = jnp.where(st.role == PRECANDIDATE, T_PREVOTE_RESP, T_VOTE_RESP)
-    st_vr = _candidate_vote_resp(cfg, iid, slot, st, m, from_slot)
+def _lane_vote_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                    m: MsgSlots, from_slot):
+    """Lane KIND_VOTE_RESP: T_VOTE_RESP / T_PREVOTE_RESP
+    (ref: raft.go:1399-1414)."""
+    st1, dead, lower = _term_gate(cfg, iid, slot, st, m, from_slot)
+    is_cand = (st1.role == CANDIDATE) | (st1.role == PRECANDIDATE)
+    my_resp_type = jnp.where(
+        st1.role == PRECANDIDATE, T_PREVOTE_RESP, T_VOTE_RESP
+    )
+    st_vr = _candidate_vote_resp(cfg, iid, slot, st1, m, from_slot)
+    st_live = _sel(is_cand & (m.type == my_resp_type), st_vr, st1)
+    return _sel(dead | lower, st, st_live)
 
-    # ---- select ----
-    out_st, out_resp = st, no_resp
-    out_st = _sel(is_vote_req, st_vote, out_st)
-    out_resp = _sel(is_vote_req, vote_resp, out_resp)
 
-    app_case = (m.type == T_APP) & leader_traffic_ok
-    out_st = _sel(app_case, st_app, out_st)
-    out_resp = _sel(app_case, app_resp, out_resp)
+def _lane_app_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                   m: MsgSlots, from_slot):
+    """Lane KIND_APP_RESP: T_APP_RESP (ref: raft.go:1106-1283)."""
+    st1, dead, lower = _term_gate(cfg, iid, slot, st, m, from_slot)
+    is_leader = st1.role == LEADER
+    st_ar = _leader_app_resp(cfg, st1, m, from_slot)
+    st_live = _sel(is_leader & (m.type == T_APP_RESP), st_ar, st1)
+    return _sel(dead | lower, st, st_live)
 
-    hb_case = (m.type == T_HB) & leader_traffic_ok
-    out_st = _sel(hb_case, st_hb, out_st)
-    out_resp = _sel(hb_case, hb_resp, out_resp)
 
-    snap_case = (m.type == T_SNAP) & leader_traffic_ok
-    out_st = _sel(snap_case, st_snap, out_st)
-    out_resp = _sel(snap_case, snap_resp, out_resp)
-
-    out_st = _sel((m.type == T_APP_RESP) & is_leader, st_ar, out_st)
-    out_st = _sel((m.type == T_HB_RESP) & is_leader, st_hr, out_st)
-    out_st = _sel(is_cand & (m.type == my_resp_type), st_vr, out_st)
-    return out_st, out_resp
+def _lane_hb_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                  m: MsgSlots, from_slot):
+    """Lane KIND_HB_RESP: T_HB_RESP, plus T_APP_RESP stale-leader nudges
+    that route back in this lane (ref: raft.go:1284-1309)."""
+    st1, dead, lower = _term_gate(cfg, iid, slot, st, m, from_slot)
+    is_leader = st1.role == LEADER
+    st_hr = _leader_hb_resp(cfg, st1, m, from_slot)
+    st_ar = _leader_app_resp(cfg, st1, m, from_slot)
+    st_live = st1
+    st_live = _sel(is_leader & (m.type == T_HB_RESP), st_hr, st_live)
+    st_live = _sel(is_leader & (m.type == T_APP_RESP), st_ar, st_live)
+    return _sel(dead | lower, st, st_live)
 
 
 def _handle_append(cfg: BatchedConfig, st: BatchedState, m: MsgSlots):
@@ -614,29 +650,45 @@ def _candidate_vote_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
 # -----------------------------------------------------------------------------
 
 
+_LANE_HANDLERS = (
+    _lane_vote, _lane_app, _lane_hb,
+    _lane_vote_resp, _lane_app_resp, _lane_hb_resp,
+)
+
+
 def _deliver_all(cfg: BatchedConfig, iid, slot, st: BatchedState,
                  inbox: MsgSlots):
-    """Scan this instance's R*K inbox slots in fixed (sender, kind)
-    order; collect responses for request kinds 0..2."""
+    """Deliver this instance's inbox lane-by-lane (senders in ascending
+    order within a lane — the fixed order the shadow oracle replicates).
+    Each lane runs its specialized handler, so a slot only ever pays for
+    the message types that can land in it; responses are collected for
+    the request lanes 0..2 and route back in lanes 3..5."""
     r = cfg.num_replicas
-    m_flat = jax.tree.map(
-        lambda x: x.reshape((r * NUM_KINDS,) + x.shape[2:]), inbox
-    )
-    senders = jnp.repeat(jnp.arange(r, dtype=I32), NUM_KINDS)
+    senders = jnp.arange(r, dtype=I32)
 
-    def body(carry, xs):
-        msg, sender = xs
-        st2, resp = _deliver_one(cfg, iid, slot, carry, msg, sender)
-        return st2, resp
+    req_resps = []
+    for k, handler in enumerate(_LANE_HANDLERS):
+        msgs_k = jax.tree.map(lambda x, _k=k: x[:, _k], inbox)  # [R, ...]
+        if k < 3:
+            def body(carry, xs, _h=handler):
+                m, s = xs
+                st2, resp = _h(cfg, iid, slot, carry, m, s)
+                return st2, resp
 
-    st_out, resps = jax.lax.scan(body, st, (m_flat, senders))
-    # [R*K] responses → [R, K]; requests live in kinds 0..2, their
-    # responses route back to the sender in kinds 3..5.
-    resps = jax.tree.map(
-        lambda x: x.reshape((r, NUM_KINDS) + x.shape[1:]), resps
+            st, resps_k = jax.lax.scan(body, st, (msgs_k, senders))
+            req_resps.append(resps_k)
+        else:
+            def body(carry, xs, _h=handler):
+                m, s = xs
+                return _h(cfg, iid, slot, carry, m, s), 0
+
+            st, _ = jax.lax.scan(body, st, (msgs_k, senders))
+
+    # [R] per request lane → [R, 3].
+    req = jax.tree.map(
+        lambda a, b, c: jnp.stack((a, b, c), axis=1), *req_resps
     )
-    req_resps = jax.tree.map(lambda x: x[:, :3], resps)  # [R, 3]
-    return st_out, req_resps
+    return st, req
 
 
 def _tick(cfg: BatchedConfig, iid, slot, st: BatchedState, do_tick,
@@ -870,10 +922,29 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
             out = out._replace(valid=out.valid & ~iso)
             return sti, out, StepAux(last_tick)
 
-        sti, out, aux = jax.vmap(per_instance)(
-            iids, slots, st, inbox, tick_mask, campaign_mask, propose_n,
-            isolate,
-        )
+        if cfg.lanes_minor:
+            # Instance axis minor inside the kernel: every elementwise
+            # op fills the TPU vector lanes with N, not with R/K/W.
+            to_minor = lambda x: (
+                jnp.moveaxis(x, 0, -1) if x.ndim > 1 else x
+            )
+            to_major = lambda x: (
+                jnp.moveaxis(x, -1, 0) if x.ndim > 1 else x
+            )
+            args = jax.tree.map(
+                to_minor,
+                (iids, slots, st, inbox, tick_mask, campaign_mask,
+                 propose_n, isolate),
+            )
+            sti, out, aux = jax.vmap(
+                per_instance, in_axes=-1, out_axes=-1
+            )(*args)
+            sti, out, aux = jax.tree.map(to_major, (sti, out, aux))
+        else:
+            sti, out, aux = jax.vmap(per_instance)(
+                iids, slots, st, inbox, tick_mask, campaign_mask,
+                propose_n, isolate,
+            )
         if with_aux:
             return sti, out, aux
         return sti, out
