@@ -110,6 +110,41 @@ class WatchHandle:
             self._cv.notify_all()
 
 
+class ObserveHandle:
+    """One election Observe stream: leader kvs pushed by the server
+    (ref: v3election.go:76-91 Observe)."""
+
+    def __init__(self, client: "Client", observe_id: int) -> None:
+        self.c = client
+        self.observe_id = observe_id
+        self.canceled = False
+        self._q: List = []
+        self._cv = threading.Condition()
+
+    def _push(self, kv) -> None:
+        with self._cv:
+            self._q.append(kv)
+            self._cv.notify_all()
+
+    def get(self, timeout: Optional[float] = None):
+        """Next leader kv; None on timeout."""
+        with self._cv:
+            if not self._q:
+                self._cv.wait(timeout)
+            return self._q.pop(0) if self._q else None
+
+    def cancel(self) -> None:
+        self.canceled = True
+        try:
+            self.c._request("ObserveCancel", {"observe_id": self.observe_id})
+        except Exception:  # noqa: BLE001
+            pass
+        with self.c._lock:
+            self.c._observes.pop(self.observe_id, None)
+        with self._cv:
+            self._cv.notify_all()
+
+
 class Client:
     def __init__(
         self,
@@ -133,6 +168,7 @@ class Client:
         self._next_id = 1
         self._pending: Dict[int, _Pending] = {}
         self._watches: Dict[int, WatchHandle] = {}
+        self._observes: Dict[int, "ObserveHandle"] = {}
         self._closed = False
         self._reconnect_gen = 0
 
@@ -206,6 +242,12 @@ class Client:
                         ev["revision"],
                         [wire.dec_event(d) for d in ev.get("events", [])],
                     )
+                continue
+            if "ostream" in frame:
+                with self._lock:
+                    oh = self._observes.get(frame["ostream"])
+                if oh is not None:
+                    oh._push(wire.dec_kv(frame["kv"]))
                 continue
             rid = frame.get("id")
             with self._lock:
@@ -467,6 +509,50 @@ class Client:
 
     def alarm(self, req: sapi.AlarmRequest) -> sapi.AlarmResponse:
         return wire.dec_response("Alarm", self._request("Alarm", wire.enc(req)))
+
+    # -- election/lock services (server/etcdserver/api/v3election, v3lock) -----
+
+    def lock(self, name: bytes, lease: int, timeout: Optional[float] = None) -> bytes:
+        """Server-side Lock RPC (v3lock.go:28-46): blocks on the server
+        until the lease owns the mutex; returns the ownership key."""
+        params: Dict[str, Any] = {"name": name.hex(), "lease": lease}
+        if timeout:
+            params["timeout"] = timeout
+        rpc_timeout = (timeout + 5.0) if timeout else 24 * 3600.0
+        resp = self._request("Lock", params, timeout=rpc_timeout)
+        return bytes.fromhex(resp["key"])
+
+    def unlock(self, key: bytes) -> None:
+        self._request("Unlock", {"key": key.hex()})
+
+    def campaign(self, name: bytes, lease: int, value: bytes,
+                 timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Server-side Campaign RPC (v3election.go:42-58); returns the
+        LeaderKey dict {name, key, rev, lease} proving leadership."""
+        params: Dict[str, Any] = {
+            "name": name.hex(), "lease": lease, "value": value.hex()}
+        if timeout:
+            params["timeout"] = timeout
+        rpc_timeout = (timeout + 5.0) if timeout else 24 * 3600.0
+        return self._request("Campaign", params, timeout=rpc_timeout)["leader"]
+
+    def proclaim(self, leader: Dict[str, Any], value: bytes) -> None:
+        self._request("Proclaim", {"leader": leader, "value": value.hex()})
+
+    def resign(self, leader: Dict[str, Any]) -> None:
+        self._request("Resign", {"leader": leader})
+
+    def election_leader(self, name: bytes):
+        resp = self._request("Leader", {"name": name.hex()})
+        return wire.dec_kv(resp["kv"])
+
+    def observe(self, name: bytes) -> "ObserveHandle":
+        """Server-side Observe stream: leader kvs as they change."""
+        resp = self._request("Observe", {"name": name.hex()})
+        oh = ObserveHandle(self, resp["observe_id"])
+        with self._lock:
+            self._observes[oh.observe_id] = oh
+        return oh
 
     # -- auth ------------------------------------------------------------------
 

@@ -25,12 +25,31 @@ from .util import prefix_end as _prefix_end
 class Session:
     """ref: concurrency/session.go — lease + keepalive."""
 
-    def __init__(self, client: Client, ttl: int = 10) -> None:
+    def __init__(self, client: Client, ttl: int = 10,
+                 lease_id: int = 0) -> None:
+        """With ``lease_id`` the session adopts an existing lease
+        (concurrency.WithLease, session.go:32-38) instead of granting
+        one; the caller owns its lifetime."""
         self.client = client
-        resp = client.lease_grant(ttl=ttl)
-        self.lease_id = resp.id
+        if lease_id:
+            self.lease_id = lease_id
+        else:
+            resp = client.lease_grant(ttl=ttl)
+            self.lease_id = resp.id
         self._stop_keepalive = client.lease_keep_alive(self.lease_id)
         self._closed = False
+
+    @classmethod
+    def from_lease(cls, client: Client, lease_id: int) -> "Session":
+        """An orphaned session around a caller-owned lease: no
+        keepalive, no revoke-on-close (the pattern of the server-side
+        lock/election services, ref v3lock.go:30-37 NewSession+Orphan)."""
+        s = cls.__new__(cls)
+        s.client = client
+        s.lease_id = lease_id
+        s._stop_keepalive = lambda: None
+        s._closed = False
+        return s
 
     def close(self) -> None:
         """Revoke the lease: all owned locks/leadership vanish at once."""

@@ -654,15 +654,17 @@ def cmd_role(args, pr: Printer) -> int:
 
 
 def cmd_lock(args, pr: Printer) -> int:
-    from ..client.concurrency import Mutex, Session
+    """Drives the server-side Lock/Unlock RPCs (v3lock.go) — the lock
+    logic runs in the server, the CLI only owns the session lease."""
+    from ..client.concurrency import Session
 
     c = _client(args)
     try:
         s = Session(c, ttl=args.ttl)
-        m = Mutex(s, args.lockname)
-        m.lock(timeout=args.command_timeout)
+        key = c.lock(args.lockname.encode(), s.lease_id,
+                     timeout=args.command_timeout)
         try:
-            print(m.my_key.decode("utf-8", "replace"))
+            print(key.decode("utf-8", "replace"))
             if args.exec_command:
                 import subprocess
 
@@ -670,7 +672,7 @@ def cmd_lock(args, pr: Printer) -> int:
             # Hold until interrupted (the reference blocks).
             time.sleep(args.hold_seconds)
         finally:
-            m.unlock()
+            c.unlock(key)
             s.close()
         return 0
     except KeyboardInterrupt:
@@ -680,22 +682,23 @@ def cmd_lock(args, pr: Printer) -> int:
 
 
 def cmd_elect(args, pr: Printer) -> int:
-    from ..client.concurrency import Election, Session
+    """Drives the server-side Campaign/Leader/Resign RPCs
+    (v3election.go)."""
+    from ..client.concurrency import Session
 
     c = _client(args)
     try:
-        s = Session(c, ttl=args.ttl)
-        e = Election(s, args.election)
         if args.listen:
-            resp = e.leader()
-            if resp is not None and resp.kvs:
-                print(resp.kvs[0].value.decode("utf-8", "replace"))
+            kv = c.election_leader(args.election.encode())
+            print(kv.value.decode("utf-8", "replace"))
             return 0
-        e.campaign((args.proposal or "default").encode(),
-                   timeout=args.command_timeout)
-        print(e.leader_key.decode("utf-8", "replace"))
+        s = Session(c, ttl=args.ttl)
+        leader = c.campaign(args.election.encode(), s.lease_id,
+                            (args.proposal or "default").encode(),
+                            timeout=args.command_timeout)
+        print(bytes.fromhex(leader["key"]).decode("utf-8", "replace"))
         time.sleep(args.hold_seconds)
-        e.resign()
+        c.resign(leader)
         s.close()
         return 0
     except KeyboardInterrupt:

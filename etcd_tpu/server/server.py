@@ -199,6 +199,14 @@ class EtcdServer:
         self.lessor.checkpointer = self._lease_checkpoint_via_raft
         self.lessor.range_deleter = lambda: _LeaseDeleterTxn(self)
 
+        # Election/lock services over the loopback client
+        # (ref: embed/etcd.go registering v3election/v3lock on v3client).
+        from .v3election import ElectionServer
+        from .v3lock import LockServer
+
+        self.election_server = ElectionServer(self)
+        self.lock_server = LockServer(self)
+
         self.compactor = None
         if cfg.auto_compaction_mode:
             from .compactor import new_compactor

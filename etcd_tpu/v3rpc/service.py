@@ -73,6 +73,9 @@ class _Conn(FramedServerConn):
         self.srv = srv
         self.watch_stream = None
         self._watch_poller: Optional[threading.Thread] = None
+        self._observers: Dict[int, threading.Event] = {}
+        self._next_observe_id = 0
+        self._obs_lock = threading.Lock()
         super().__init__(sock, srv._stopped)
 
     def _send(self, obj: Dict[str, Any]) -> bool:
@@ -84,6 +87,11 @@ class _Conn(FramedServerConn):
     def on_close(self) -> None:
         if self.watch_stream is not None:
             self.watch_stream.close()
+        with self._obs_lock:
+            observers = list(self._observers.values())
+            self._observers.clear()
+        for stop in observers:
+            stop.set()
         self.srv._conns.discard(self.sock)
 
     # -- dispatch --------------------------------------------------------------
@@ -183,6 +191,21 @@ class _Conn(FramedServerConn):
             os.remove(tmp)
             return {"blob": data.hex()}
 
+        if method in ("Campaign", "Proclaim", "Leader", "Resign",
+                      "Observe", "ObserveCancel"):
+            return self._election(method, params, token)
+        if method == "Lock":
+            # Bounded even for "wait forever" callers so an abandoned
+            # conn can't pin a handler thread indefinitely.
+            timeout = params.get("timeout") or 24 * 3600.0
+            key = s.lock_server.lock(
+                bytes.fromhex(params["name"]), params["lease"],
+                timeout=timeout, token=token)
+            return {"key": key.hex(), "revision": s.kv.rev()}
+        if method == "Unlock":
+            s.lock_server.unlock(bytes.fromhex(params["key"]), token=token)
+            return {"revision": s.kv.rev()}
+
         if method == "Authenticate":
             token_out = s.authenticate(params["name"], params["password"])
             return {"token": token_out}
@@ -217,6 +240,68 @@ class _Conn(FramedServerConn):
             return {"roles": s.auth_store.role_list()}
 
         raise ValueError(f"unknown method {method!r}")
+
+    # -- election/lock (v3election.go / v3lock.go) ----------------------------
+
+    def _election(self, method: str, params: Dict, token: Optional[str]):
+        from ..server.v3election import LeaderKey
+
+        s = self.srv.s
+        es = s.election_server
+
+        def dec_leader(d: Dict) -> LeaderKey:
+            return LeaderKey(
+                name=bytes.fromhex(d["name"]), key=bytes.fromhex(d["key"]),
+                rev=d["rev"], lease=d["lease"])
+
+        def enc_leader(lk: LeaderKey) -> Dict:
+            return {"name": lk.name.hex(), "key": lk.key.hex(),
+                    "rev": lk.rev, "lease": lk.lease}
+
+        if method == "Campaign":
+            lk = es.campaign(
+                bytes.fromhex(params["name"]), params["lease"],
+                bytes.fromhex(params.get("value", "")),
+                timeout=params.get("timeout") or 24 * 3600.0, token=token)
+            return {"leader": enc_leader(lk), "revision": s.kv.rev()}
+        if method == "Proclaim":
+            es.proclaim(dec_leader(params["leader"]),
+                        bytes.fromhex(params.get("value", "")), token=token)
+            return {"revision": s.kv.rev()}
+        if method == "Resign":
+            es.resign(dec_leader(params["leader"]), token=token)
+            return {"revision": s.kv.rev()}
+        if method == "Leader":
+            kv = es.leader(bytes.fromhex(params["name"]), token=token)
+            return {"kv": wire.enc(kv), "revision": s.kv.rev()}
+        if method == "Observe":
+            with self._obs_lock:
+                oid = self._next_observe_id
+                self._next_observe_id += 1
+                stop = threading.Event()
+                self._observers[oid] = stop
+            name = bytes.fromhex(params["name"])
+
+            def pump() -> None:
+                def push(kv) -> bool:
+                    return self._send({"ostream": oid, "kv": wire.enc(kv)})
+
+                try:
+                    es.observe(name, push, stop, token=token)
+                finally:
+                    with self._obs_lock:
+                        self._observers.pop(oid, None)
+
+            threading.Thread(target=pump, daemon=True,
+                             name=f"observe-{oid}").start()
+            return {"observe_id": oid}
+        if method == "ObserveCancel":
+            with self._obs_lock:
+                stop = self._observers.pop(params["observe_id"], None)
+            if stop is not None:
+                stop.set()
+            return {}
+        raise ValueError(f"unknown election method {method!r}")
 
     # -- watch (watch.go stream loops) ----------------------------------------
 

@@ -1,0 +1,152 @@
+"""Server-side v3election / v3lock service tests
+(ref: tests/integration/v3election_grpc_test.go,
+v3lock_grpc_test.go — contention, proclaim guard, observe stream)."""
+
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.client.client import Client, ClientError
+from etcd_tpu.client.concurrency import Session
+from tests.framework.integration import IntegrationCluster
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = IntegrationCluster(str(tmp_path), n=1)
+    c.wait_leader()
+    yield c
+    c.close()
+
+
+def _client(cluster) -> Client:
+    return cluster.members[1].client(via_bridge=False)
+
+
+def test_lock_contention_two_clients(cluster):
+    """Two clients contend through the Lock RPC: the second blocks
+    until the first unlocks (v3lock.go:28-46)."""
+    c1, c2 = _client(cluster), _client(cluster)
+    s1, s2 = Session(c1, ttl=30), Session(c2, ttl=30)
+    try:
+        k1 = c1.lock(b"testlock", s1.lease_id)
+        assert k1.startswith(b"testlock/")
+
+        acquired = []
+        t = threading.Thread(
+            target=lambda: acquired.append(c2.lock(b"testlock", s2.lease_id)),
+            daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert not acquired, "second lock acquired while first held"
+
+        c1.unlock(k1)
+        t.join(timeout=10)
+        assert acquired and acquired[0] != k1
+        c2.unlock(acquired[0])
+    finally:
+        s1.close()
+        s2.close()
+        c1.close()
+        c2.close()
+
+
+def test_lock_released_by_session_close(cluster):
+    """Revoking the owner's lease frees the lock: the ownership key is
+    attached to the lease (v3lock.go session semantics)."""
+    c1, c2 = _client(cluster), _client(cluster)
+    s1, s2 = Session(c1, ttl=30), Session(c2, ttl=30)
+    try:
+        c1.lock(b"lk", s1.lease_id)
+        s1.close()  # revokes the lease → deletes the key
+        k2 = c2.lock(b"lk", s2.lease_id, timeout=10)
+        assert k2
+        c2.unlock(k2)
+    finally:
+        s2.close()
+        c1.close()
+        c2.close()
+
+
+def test_campaign_leader_resign(cluster):
+    """Campaign/Leader/Resign through the server service
+    (v3election.go:42-74)."""
+    c1, c2 = _client(cluster), _client(cluster)
+    s1, s2 = Session(c1, ttl=30), Session(c2, ttl=30)
+    try:
+        lk1 = c1.campaign(b"pres", s1.lease_id, b"alice")
+        kv = c1.election_leader(b"pres")
+        assert kv.value == b"alice"
+
+        # Second campaigner blocks until the first resigns.
+        won = []
+        t = threading.Thread(
+            target=lambda: won.append(
+                c2.campaign(b"pres", s2.lease_id, b"bob")),
+            daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert not won
+
+        c1.resign(lk1)
+        t.join(timeout=10)
+        assert won
+        kv = c2.election_leader(b"pres")
+        assert kv.value == b"bob"
+    finally:
+        s1.close()
+        s2.close()
+        c1.close()
+        c2.close()
+
+
+def test_proclaim_updates_value_and_guards_revision(cluster):
+    """Proclaim rewrites the leader value without re-electing; a stale
+    LeaderKey is rejected (v3election.go:60-66)."""
+    c = _client(cluster)
+    s = Session(c, ttl=30)
+    try:
+        lk = c.campaign(b"cfg", s.lease_id, b"v1")
+        c.proclaim(lk, b"v2")
+        assert c.election_leader(b"cfg").value == b"v2"
+
+        stale = dict(lk)
+        stale["rev"] = lk["rev"] + 100
+        with pytest.raises(ClientError):
+            c.proclaim(stale, b"v3")
+        assert c.election_leader(b"cfg").value == b"v2"
+    finally:
+        s.close()
+        c.close()
+
+
+def test_leader_with_no_election_errors(cluster):
+    c = _client(cluster)
+    try:
+        with pytest.raises(ClientError) as ei:
+            c.election_leader(b"nobody")
+        assert "NoLeader" in ei.value.etype
+    finally:
+        c.close()
+
+
+def test_observe_streams_leader_changes(cluster):
+    """Observe pushes the current leader and each change
+    (v3election.go:76-91)."""
+    c1, c2 = _client(cluster), _client(cluster)
+    s1 = Session(c1, ttl=30)
+    try:
+        lk = c1.campaign(b"obs", s1.lease_id, b"first")
+        oh = c2.observe(b"obs")
+        kv = oh.get(timeout=10)
+        assert kv is not None and kv.value == b"first"
+
+        c1.proclaim(lk, b"second")
+        kv = oh.get(timeout=10)
+        assert kv is not None and kv.value == b"second"
+        oh.cancel()
+    finally:
+        s1.close()
+        c1.close()
+        c2.close()
