@@ -20,10 +20,15 @@ with commit-p50 detail inside "unit".
 """
 
 import json
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+
+def _note(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
 def _make_engine(groups: int, lanes_minor: bool):
@@ -71,14 +76,23 @@ def main() -> None:
     rates = {}
     for lm in (False, True):
         try:
+            t0 = time.perf_counter()
             eng, props = _make_engine(probe_g, lm)
+            _note(f"probe layout={'minor' if lm else 'major'} built+compiled "
+                  f"in {time.perf_counter()-t0:.1f}s")
             rates[lm] = _rate(eng, props, 8, 2)
-        except Exception:  # noqa: BLE001 — fall back to the other layout
+            _note(f"probe layout={'minor' if lm else 'major'}: "
+                  f"{rates[lm]:.0f} group-rounds/s")
+        except Exception as e:  # noqa: BLE001 — fall back to the other layout
+            _note(f"probe layout={'minor' if lm else 'major'} failed: {e!r}")
             rates[lm] = 0.0
     lanes_minor = rates.get(True, 0.0) >= rates.get(False, 0.0)
 
+    t0 = time.perf_counter()
     eng, props = _make_engine(groups, lanes_minor)
+    _note(f"main G={groups} built+compiled in {time.perf_counter()-t0:.1f}s")
     rate = _rate(eng, props, 16, 8)
+    _note(f"main rate: {rate:.0f} group-rounds/s")
     commits = eng.commits()
     assert commits.min() > 0
 

@@ -87,7 +87,7 @@ class Config:
     # Ops.
     enable_pprof: bool = False
     log_level: str = "info"
-    auth_token: str = "simple"  # "simple" | "hmac:<key>"
+    auth_token: str = "simple"  # "simple" | "hmac:<key>" | "jwt,sign-key=<k>[,sign-method=HS256][,ttl=5m]"
     strict_reconfig_check: bool = True
 
     # -- derived ---------------------------------------------------------------

@@ -144,7 +144,7 @@ class ServerConfig:
     pre_vote: bool = True
     request_timeout: float = 7.0
     max_request_bytes: int = 1536 * 1024  # ref: embed/config.go DefaultMaxRequestBytes
-    auth_token: str = "simple"  # "simple" | "hmac:<key>" (ref: --auth-token)
+    auth_token: str = "simple"  # "simple" | "hmac:<key>" | "jwt,sign-key=<k>[,sign-method=HS256][,ttl=5m]" (ref: --auth-token)
 
 
 @dataclass
@@ -251,6 +251,11 @@ class EtcdServer:
             from ..auth.hmac_token import HMACTokenProvider
 
             provider = HMACTokenProvider(spec[len("hmac:"):].encode())
+        elif spec == "jwt" or spec.startswith("jwt,"):
+            from ..auth.jwt_token import JWTTokenProvider
+
+            provider = JWTTokenProvider.from_opts(spec[len("jwt,"):] if
+                                                  "," in spec else "")
         else:
             provider = SimpleTokenProvider()
         self.auth_store = AuthStore(self.be, token_provider=provider)
