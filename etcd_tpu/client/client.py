@@ -11,6 +11,7 @@ transparently when safe (idempotent or connection-refused-before-send).
 from __future__ import annotations
 
 import socket
+import ssl
 import threading
 import time
 from dataclasses import dataclass, field
@@ -153,6 +154,7 @@ class Client:
         password: str = "",
         dial_timeout: float = 2.0,
         request_timeout: float = 10.0,
+        tls_info=None,
     ) -> None:
         self.endpoints = list(endpoints)
         self._ep_index = 0
@@ -161,6 +163,15 @@ class Client:
         self.token: Optional[str] = None
         self.dial_timeout = dial_timeout
         self.request_timeout = request_timeout
+        # Client-channel TLS (ref: clientv3 TLS config via
+        # client/pkg/transport ClientConfig).
+        self._ssl = None
+        self._tls_server_name = ""
+        if tls_info is not None:
+            # A CA-only TLSInfo is valid for a client (server cert
+            # verification without mutual TLS).
+            self._ssl = tls_info.client_context()
+            self._tls_server_name = tls_info.server_name
 
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
@@ -191,6 +202,9 @@ class Client:
     def _connect(self, ep: Tuple[str, int]) -> None:
         sock = socket.create_connection(ep, timeout=self.dial_timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._ssl is not None:
+            sock = self._ssl.wrap_socket(
+                sock, server_hostname=self._tls_server_name or ep[0])
         sock.settimeout(None)
         with self._lock:
             self._sock = sock

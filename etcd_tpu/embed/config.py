@@ -84,6 +84,17 @@ class Config:
     max_request_bytes: int = 1536 * 1024
     auto_compaction_mode: str = ""
     auto_compaction_retention: str = "0"
+    # TLS (ref: embed/config.go ClientTLSInfo/PeerTLSInfo + --auto-tls).
+    cert_file: str = ""
+    key_file: str = ""
+    trusted_ca_file: str = ""
+    client_cert_auth: bool = False
+    auto_tls: bool = False
+    peer_cert_file: str = ""
+    peer_key_file: str = ""
+    peer_trusted_ca_file: str = ""
+    peer_client_cert_auth: bool = False
+    peer_auto_tls: bool = False
     # Ops.
     enable_pprof: bool = False
     log_level: str = "info"
@@ -128,6 +139,58 @@ class Config:
             raise ConfigError(
                 f"auto-compaction-mode must be periodic|revision, got {mode!r}"
             )
+        for which, cert, key, auto in (
+            ("client", self.cert_file, self.key_file, self.auto_tls),
+            ("peer", self.peer_cert_file, self.peer_key_file,
+             self.peer_auto_tls),
+        ):
+            if bool(cert) != bool(key):
+                raise ConfigError(
+                    f"{which} cert-file and key-file must be given together")
+            if auto and cert:
+                raise ConfigError(
+                    f"{which} auto-tls is mutually exclusive with cert-file")
+        for which, cc_auth, ca in (
+            ("client", self.client_cert_auth, self.trusted_ca_file),
+            ("peer", self.peer_client_cert_auth, self.peer_trusted_ca_file),
+        ):
+            if cc_auth and not ca:
+                raise ConfigError(
+                    f"{which} client-cert-auth requires trusted-ca-file "
+                    f"(an empty trust store would reject every handshake)")
+
+    def client_tls_info(self):
+        """TLSInfo for the client channel, or None when insecure
+        (ref: embed/config.go ClientSelfCert / ClientTLSInfo)."""
+        return self._tls_info(
+            self.cert_file, self.key_file, self.trusted_ca_file,
+            self.client_cert_auth, self.auto_tls, "client-certs")
+
+    def peer_tls_info(self):
+        """TLSInfo for the peer channel, or None (PeerSelfCert)."""
+        return self._tls_info(
+            self.peer_cert_file, self.peer_key_file,
+            self.peer_trusted_ca_file, self.peer_client_cert_auth,
+            self.peer_auto_tls, "peer-certs")
+
+    def _tls_info(self, cert, key, ca, cc_auth, auto, subdir):
+        from ..pkg.tlsutil import TLSInfo, self_cert
+
+        if auto:
+            import os
+
+            hosts = sorted({
+                u[0] for u in parse_urls(self.listen_peer_urls)
+            } | {u[0] for u in parse_urls(self.listen_client_urls)} | {
+                "127.0.0.1", "localhost"})
+            info = self_cert(os.path.join(self.data_dir, "fixtures", subdir),
+                             hosts=hosts)
+            info.client_cert_auth = cc_auth
+            return info
+        if not cert:
+            return None
+        return TLSInfo(cert_file=cert, key_file=key, trusted_ca_file=ca,
+                       client_cert_auth=cc_auth)
 
     def initial_cluster_map(self) -> Dict[str, str]:
         """"n1=u1,n2=u2" → {name: peer_urls} (multiple URLs per name keep

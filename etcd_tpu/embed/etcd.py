@@ -106,7 +106,8 @@ def start_etcd(cfg: Config) -> Etcd:
 
     peer_bind = parse_urls(cfg.listen_peer_urls)[0]
     transport = TCPTransport(
-        member_id=my_id, cluster_id=cluster_id, bind=peer_bind
+        member_id=my_id, cluster_id=cluster_id, bind=peer_bind,
+        tls_info=cfg.peer_tls_info(),
     )
     e.transport = transport
     for nm, urls in cluster.items():
@@ -141,7 +142,8 @@ def start_etcd(cfg: Config) -> Etcd:
         transport.set_raft_reporter(server.node)
 
         client_bind = parse_urls(cfg.listen_client_urls)[0]
-        e.rpc = V3RPCServer(server, bind=client_bind)
+        e.rpc = V3RPCServer(server, bind=client_bind,
+                            tls_info=cfg.client_tls_info())
 
         if cfg.listen_metrics_urls:
             metrics_bind = parse_urls(cfg.listen_metrics_urls)[0]

@@ -274,9 +274,21 @@ def _parse_op(line: str) -> sapi.RequestOp:
 
 
 def _client(args) -> Client:
+    tls_info = None
+    if getattr(args, "cacert", "") or getattr(args, "cert", "") or \
+            getattr(args, "insecure_skip_tls_verify", False):
+        from ..pkg.tlsutil import TLSInfo
+
+        tls_info = TLSInfo(
+            trusted_ca_file=args.cacert,
+            client_cert_file=args.cert,
+            client_key_file=args.key,
+            insecure_skip_verify=args.insecure_skip_tls_verify,
+        )
     c = Client(
         _parse_endpoints(args.endpoints),
         request_timeout=args.command_timeout,
+        tls_info=tls_info,
     )
     if args.user:
         if ":" in args.user:
@@ -809,6 +821,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--password", default="")
     p.add_argument("--dial-timeout", type=float, default=2.0)
     p.add_argument("--command-timeout", type=float, default=5.0)
+    p.add_argument("--cacert", default="")
+    p.add_argument("--cert", default="")
+    p.add_argument("--key", default="")
+    p.add_argument("--insecure-skip-tls-verify", action="store_true")
     sub = p.add_subparsers(dest="cmd")
 
     sp = sub.add_parser("put")

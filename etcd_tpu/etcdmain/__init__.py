@@ -43,6 +43,16 @@ def _add_member_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--auto-compaction-mode", default="")
     p.add_argument("--auto-compaction-retention", default="0")
     p.add_argument("--auth-token", default=cfg.auth_token)
+    p.add_argument("--cert-file", default="")
+    p.add_argument("--key-file", default="")
+    p.add_argument("--trusted-ca-file", default="")
+    p.add_argument("--client-cert-auth", action="store_true")
+    p.add_argument("--auto-tls", action="store_true")
+    p.add_argument("--peer-cert-file", default="")
+    p.add_argument("--peer-key-file", default="")
+    p.add_argument("--peer-trusted-ca-file", default="")
+    p.add_argument("--peer-client-cert-auth", action="store_true")
+    p.add_argument("--peer-auto-tls", action="store_true")
     p.add_argument("--discovery-endpoints", default="")
     p.add_argument("--discovery-token", default="")
     p.add_argument("--log-level", default=cfg.log_level)

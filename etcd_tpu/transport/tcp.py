@@ -26,6 +26,7 @@ from __future__ import annotations
 import queue
 import random
 import socket
+import ssl
 import struct
 import threading
 import time
@@ -149,11 +150,16 @@ class _Peer:
         try:
             s = socket.create_connection(self.addr, timeout=2.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.t._client_ssl is not None:
+                # Peer channel TLS (ref: rafthttp dials through
+                # transport.TLSInfo ClientConfig, listener.go:376).
+                s = self.t._client_ssl.wrap_socket(
+                    s, server_hostname=self.t._tls_server_name or self.addr[0])
             s.sendall(_HELLO.pack(self.t.cluster_id, self.t.member_id))
             if self.active_since == 0.0:
                 self.active_since = time.monotonic()
             return s
-        except OSError:
+        except OSError:  # covers ssl.SSLError
             return None
 
 
@@ -165,9 +171,18 @@ class TCPTransport:
         member_id: int,
         cluster_id: int = 0,
         bind: Tuple[str, int] = ("127.0.0.1", 0),
+        tls_info=None,
     ) -> None:
         self.member_id = member_id
         self.cluster_id = cluster_id
+        # Peer-channel TLS both ways (ref: --peer-cert-file/--peer-key-file,
+        # listener.go NewTLSListener on the server side).
+        self._server_ssl = self._client_ssl = None
+        self._tls_server_name = ""
+        if tls_info is not None and not tls_info.empty():
+            self._server_ssl = tls_info.server_context()
+            self._client_ssl = tls_info.client_context()
+            self._tls_server_name = tls_info.server_name
         self._lock = threading.Lock()
         self._peers: Dict[int, _Peer] = {}
         self._handler: Optional[Callable[[Message], None]] = None
@@ -270,6 +285,13 @@ class TCPTransport:
     def _recv_loop(self, conn: socket.socket) -> None:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._server_ssl is not None:
+                # Handshake on the per-conn thread so a stalled dialer
+                # can't block the accept loop.
+                try:
+                    conn = self._server_ssl.wrap_socket(conn, server_side=True)
+                except OSError:  # covers ssl.SSLError
+                    return
             hello = self._read_exact(conn, _HELLO.size)
             if hello is None:
                 return

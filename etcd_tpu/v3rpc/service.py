@@ -11,6 +11,7 @@ frames (watch.go's sendLoop/recvLoop pair).
 from __future__ import annotations
 
 import socket
+import ssl
 import struct
 import threading
 from typing import Any, Dict, Optional
@@ -23,9 +24,14 @@ from .connbase import FramedServerConn
 
 
 class V3RPCServer:
-    def __init__(self, server, bind=("127.0.0.1", 0)) -> None:
+    def __init__(self, server, bind=("127.0.0.1", 0), tls_info=None) -> None:
         self.s = server
         self._stopped = threading.Event()
+        # Client-channel TLS (ref: embed/etcd.go serveClients over
+        # transport.NewTLSListener, listener.go:79).
+        self._ssl = None
+        if tls_info is not None and not tls_info.empty():
+            self._ssl = tls_info.server_context()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(bind)
@@ -61,8 +67,34 @@ class V3RPCServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.add(conn)
-            _Conn(self, conn)
+            if self._ssl is not None:
+                # Handshake off the accept thread: a half-open dialer
+                # must not block other clients.
+                threading.Thread(target=self._tls_accept, args=(conn,),
+                                 daemon=True).start()
+            else:
+                self._conns.add(conn)
+                _Conn(self, conn)
+
+    def _tls_accept(self, conn: socket.socket) -> None:
+        try:
+            conn = self._ssl.wrap_socket(conn, server_side=True)
+        except OSError:  # covers ssl.SSLError
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        self._conns.add(conn)
+        if self._stopped.is_set():
+            # stop() may have drained _conns while we were handshaking.
+            self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        _Conn(self, conn)
 
 
 class _Conn(FramedServerConn):
