@@ -95,6 +95,10 @@ class Config:
     peer_trusted_ca_file: str = ""
     peer_client_cert_auth: bool = False
     peer_auto_tls: bool = False
+    # Corruption checking (ref: --experimental-initial-corrupt-check,
+    # --experimental-corrupt-check-time).
+    initial_corrupt_check: bool = False
+    corrupt_check_time: float = 0.0  # seconds between periodic checks
     # Ops.
     enable_pprof: bool = False
     log_level: str = "info"

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..etcdhttp import EtcdHTTP
 from ..server import EtcdServer, ServerConfig
+from ..server.corrupt import transport_peer_fetcher
 from ..transport.tcp import TCPTransport
 from ..v3rpc.service import V3RPCServer
 from .config import (
@@ -135,11 +136,15 @@ def start_etcd(cfg: Config) -> Etcd:
         pre_vote=cfg.pre_vote,
         max_request_bytes=cfg.max_request_bytes,
         auth_token=cfg.auth_token,
+        peer_hash_fetcher=transport_peer_fetcher(transport),
+        initial_corrupt_check=cfg.initial_corrupt_check,
+        corrupt_check_time=cfg.corrupt_check_time,
     )
     try:
         server = EtcdServer(scfg)
         e.server = server
         transport.set_raft_reporter(server.node)
+        transport.set_hash_provider(lambda: server.hash_kv(0))
 
         client_bind = parse_urls(cfg.listen_client_urls)[0]
         e.rpc = V3RPCServer(server, bind=client_bind,

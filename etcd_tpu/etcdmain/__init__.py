@@ -43,6 +43,8 @@ def _add_member_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--auto-compaction-mode", default="")
     p.add_argument("--auto-compaction-retention", default="0")
     p.add_argument("--auth-token", default=cfg.auth_token)
+    p.add_argument("--initial-corrupt-check", action="store_true")
+    p.add_argument("--corrupt-check-time", type=float, default=0.0)
     p.add_argument("--cert-file", default="")
     p.add_argument("--key-file", default="")
     p.add_argument("--trusted-ca-file", default="")

@@ -521,6 +521,11 @@ class AlarmApplier:
 
         active = self.s.alarms.active_types()
         if AlarmType.CORRUPT in active:
+            # Alarm ops must pass the fence or DEACTIVATE could never
+            # disarm it (ref: corrupt.go applierV3Corrupt wraps only
+            # KV/lease ops; Alarm goes to the base applier).
+            if r.op == "alarm":
+                return self.base.apply(r)
             return ApplyResult(err=CorruptError())
         if AlarmType.NOSPACE in active and r.op in self.WRITE_OPS:
             if not (r.op == "txn" and not _is_txn_write(r.req)):

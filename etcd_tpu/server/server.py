@@ -145,6 +145,12 @@ class ServerConfig:
     request_timeout: float = 7.0
     max_request_bytes: int = 1536 * 1024  # ref: embed/config.go DefaultMaxRequestBytes
     auth_token: str = "simple"  # "simple" | "hmac:<key>" | "jwt,sign-key=<k>[,sign-method=HS256][,ttl=5m]" (ref: --auth-token)
+    # Corruption checking (ref: corrupt.go; --experimental-initial-
+    # corrupt-check / --experimental-corrupt-check-time). The fetcher
+    # resolves a peer id to its hash-KV; None disables both checks.
+    peer_hash_fetcher: Any = None
+    initial_corrupt_check: bool = False
+    corrupt_check_time: float = 0.0  # seconds; 0 → no periodic monitor
 
 
 @dataclass
@@ -218,6 +224,32 @@ class EtcdServer:
                 lambda rev: self.compact(CompactionRequest(revision=rev)),
             )
             self.compactor.run()
+
+        # Corruption checking (ref: server.go:558-563 — initial check
+        # before serving, then the periodic KV-hash monitor).
+        self.corruption_checker = None
+        if cfg.peer_hash_fetcher is not None:
+            from .corrupt import CorruptionChecker
+
+            self.corruption_checker = CorruptionChecker(
+                self, cfg.peer_hash_fetcher)
+            if cfg.initial_corrupt_check:
+                try:
+                    self.corruption_checker.initial_check()
+                except Exception:
+                    # Refuse to serve, but release what's open (the
+                    # loops below haven't started yet).
+                    if self.compactor is not None:
+                        self.compactor.stop()
+                    self.node.stop()
+                    self.sched.stop()
+                    self.kv.stop_sync_loop()
+                    self.lessor.stop()
+                    self.wal.close()
+                    self.be.close()
+                    raise
+            if cfg.corrupt_check_time > 0:
+                self.corruption_checker.start_periodic(cfg.corrupt_check_time)
 
         self.network.register(self.id, self._receive_message)
         self._ready_thread = threading.Thread(
@@ -951,6 +983,8 @@ class EtcdServer:
             return
         self._stopped.set()
         self.network.unregister(self.id)
+        if self.corruption_checker is not None:
+            self.corruption_checker.stop()
         if self.compactor is not None:
             self.compactor.stop()
         self.node.stop()

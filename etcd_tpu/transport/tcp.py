@@ -40,6 +40,9 @@ STREAM_BUF = 4096  # queued msgs per peer (streamBufSize stream.go:32)
 PIPELINE_WORKERS = 4  # pipeline.go connPerPipeline
 RECONNECT_INTERVAL = 0.1
 _HELLO = struct.Struct("<QQ")
+# First payload byte of a peer-RPC control frame; raft MessageType
+# values stay well below 0xFF, so the channel is unambiguous.
+CONTROL_BYTE = b"\xff"
 
 
 def _is_snap(m: Message) -> bool:
@@ -313,6 +316,16 @@ class TCPTransport:
                     drop = self._drop.get(from_id, 0.0)
                 if drop and self._rand.random() < drop:
                     continue
+                if payload[:1] == CONTROL_BYTE:
+                    # Peer-RPC side channel (the analog of the extra
+                    # handlers on the reference's peer listener —
+                    # hashKVHandler etc., corrupt.go:261).
+                    resp = self._handle_control(payload[1:])
+                    try:
+                        conn.sendall(struct.pack("<I", len(resp)) + resp)
+                    except OSError:
+                        return
+                    continue
                 m = decode_message(payload)
                 h = self._handler
                 if h is not None:
@@ -325,6 +338,72 @@ class TCPTransport:
                 conn.close()
             except OSError:
                 pass
+
+    # -- peer-RPC control channel ----------------------------------------------
+
+    def set_hash_provider(self, fn: Callable[[], Tuple[int, int, int]]) -> None:
+        """fn() -> (hash, revision, compact_revision) — the tuple order
+        of mvcc ``hash_kv``; served to peers asking over the control
+        channel (ref: corrupt.go:261 hashKVHandler on the peer
+        listener)."""
+        self._hash_provider = fn
+
+    def _handle_control(self, body: bytes) -> bytes:
+        import json
+
+        try:
+            req = json.loads(body)
+        except ValueError:
+            return b"{}"
+        if req.get("op") == "hashkv":
+            fn = getattr(self, "_hash_provider", None)
+            if fn is None:
+                return b"{}"
+            try:
+                h, rev, crev = fn()
+            except Exception:  # noqa: BLE001
+                return b"{}"
+            return json.dumps({
+                "member_id": self.member_id, "hash": h,
+                "compact_revision": crev, "revision": rev,
+            }).encode()
+        return b"{}"
+
+    def peer_hash_kv(self, peer_id: int, timeout: float = 3.0):
+        """One-shot control query to a peer's listener; None when the
+        peer is unreachable or doesn't answer."""
+        import json
+
+        with self._lock:
+            p = self._peers.get(peer_id)
+        if p is None:
+            return None
+        try:
+            s = p._dial()
+            if s is None:
+                return None
+            try:
+                s.settimeout(timeout)
+                body = CONTROL_BYTE + json.dumps({"op": "hashkv"}).encode()
+                s.sendall(struct.pack("<I", len(body)) + body)
+                ln_b = self._read_exact(s, 4)
+                if ln_b is None:
+                    return None
+                (ln,) = struct.unpack("<I", ln_b)
+                if ln > MAX_FRAME:
+                    return None
+                resp = self._read_exact(s, ln)
+                if resp is None:
+                    return None
+                out = json.loads(resp)
+                return out if "hash" in out else None
+            finally:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        except (OSError, ValueError):
+            return None
 
     @staticmethod
     def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:

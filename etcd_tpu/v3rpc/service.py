@@ -203,7 +203,7 @@ class _Conn(FramedServerConn):
                 "revision": s.kv.rev(),
             }
         if method == "HashKV":
-            h, crev, rev = s.hash_kv(params.get("revision", 0))
+            h, rev, crev = s.hash_kv(params.get("revision", 0))
             return {"hash": h, "compact_revision": crev, "revision": rev}
         if method == "Defragment":
             s.defrag()

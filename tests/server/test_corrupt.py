@@ -1,0 +1,242 @@
+"""Cross-member corruption monitor tests
+(ref: server/etcdserver/corrupt_test.go; e2e etcd_corrupt_test.go —
+corrupt one member's backend out-of-band, observe the CORRUPT alarm
+and the cluster-wide write fence)."""
+
+import time
+
+import pytest
+
+from etcd_tpu.server.api import AlarmType
+from etcd_tpu.server.corrupt import (
+    CorruptCheckError,
+    CorruptionChecker,
+    PeerHashKV,
+    inproc_peer_fetcher,
+)
+from etcd_tpu.storage import backend as bk
+from tests.framework.integration import IntegrationCluster
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = IntegrationCluster(str(tmp_path), n=3)
+    c.wait_leader()
+    yield c
+    c.close()
+
+
+def _servers(cluster):
+    return {m.id: m.server for m in cluster.members.values()
+            if m.server is not None}
+
+
+def _corrupt_backend(server) -> None:
+    """Flip one live value directly in the backend, leaving revisions
+    untouched — hash diverges at identical (rev, crev) coordinates,
+    the exact signature corrupt.go detects."""
+    rt = server.be.concurrent_read_tx()
+    rows = list(rt.range(bk.KEY, b"", b"\xff" * 20))
+    assert rows, "need at least one revision row to corrupt"
+    rkey, rval = rows[-1]
+    server.be.batch_tx.put(bk.KEY, rkey, rval + b"\x00corrupted")
+    server.be.force_commit()
+
+
+class TestChecker:
+    def test_initial_check_passes_on_agreement(self, cluster):
+        from etcd_tpu.server.api import PutRequest
+
+        leader = cluster.wait_leader().server
+
+        leader.put(PutRequest(key=b"k", value=b"v"))
+        for s in _servers(cluster).values():
+            ck = CorruptionChecker(s, inproc_peer_fetcher(
+                lambda: _servers(cluster)))
+            ck.initial_check()  # no divergence → no raise
+
+    def test_initial_check_detects_divergence(self, cluster):
+        from etcd_tpu.server.api import PutRequest
+
+        leader = cluster.wait_leader().server
+        leader.put(PutRequest(key=b"k", value=b"v"))
+        self._wait_applied(cluster, leader)
+        victim = next(s for s in _servers(cluster).values()
+                      if s.id != leader.id)
+        _corrupt_backend(victim)
+        ck = CorruptionChecker(leader, inproc_peer_fetcher(
+            lambda: _servers(cluster)))
+        with pytest.raises(CorruptCheckError):
+            ck.initial_check()
+
+    def test_periodic_check_alarms_deviant_member(self, cluster):
+        from etcd_tpu.server.api import PutRequest
+
+        leader = cluster.wait_leader().server
+        leader.put(PutRequest(key=b"k", value=b"v"))
+        self._wait_applied(cluster, leader)
+        victim = next(s for s in _servers(cluster).values()
+                      if s.id != leader.id)
+        _corrupt_backend(victim)
+        ck = CorruptionChecker(leader, inproc_peer_fetcher(
+            lambda: _servers(cluster)))
+        ck.periodic_check()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if AlarmType.CORRUPT in leader.alarms.active_types():
+                break
+            time.sleep(0.05)
+        alarms = leader.alarms.get(AlarmType.CORRUPT)
+        assert any(a.member_id == victim.id for a in alarms)
+
+    def test_corrupt_alarm_fences_writes_cluster_wide(self, cluster):
+        from etcd_tpu.server.apply import CorruptError
+        from etcd_tpu.server.api import PutRequest
+
+        leader = cluster.wait_leader().server
+        leader.put(PutRequest(key=b"k", value=b"v"))
+        self._wait_applied(cluster, leader)
+        victim = next(s for s in _servers(cluster).values()
+                      if s.id != leader.id)
+        _corrupt_backend(victim)
+        ck = CorruptionChecker(leader, inproc_peer_fetcher(
+            lambda: _servers(cluster)))
+        ck.periodic_check()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if AlarmType.CORRUPT in leader.alarms.active_types():
+                break
+            time.sleep(0.05)
+        with pytest.raises(CorruptError):
+            leader.put(PutRequest(key=b"k2", value=b"v2"))
+
+    def test_majority_divergence_blames_self(self, cluster):
+        """When most peers disagree with us, we are the deviant."""
+        from etcd_tpu.server.api import PutRequest
+
+        leader = cluster.wait_leader().server
+        leader.put(PutRequest(key=b"k", value=b"v"))
+        self._wait_applied(cluster, leader)
+        _corrupt_backend(leader)
+        raised = []
+        ck = CorruptionChecker(leader, inproc_peer_fetcher(
+            lambda: _servers(cluster)))
+        ck._alarm_corrupt = lambda mid: raised.append(mid)
+        ck.periodic_check()
+        assert raised == [leader.id]
+
+    def test_corrupt_alarm_can_be_disarmed(self, cluster):
+        """Alarm DEACTIVATE must pass the CORRUPT write fence, or the
+        cluster could never recover (corrupt applier lets Alarm ops
+        through to the base applier)."""
+        from etcd_tpu.server.api import (
+            AlarmAction, AlarmRequest, PutRequest)
+
+        leader = cluster.wait_leader().server
+        leader.put(PutRequest(key=b"k", value=b"v"))
+        self._wait_applied(cluster, leader)
+        victim = next(s for s in _servers(cluster).values()
+                      if s.id != leader.id)
+        _corrupt_backend(victim)
+        ck = CorruptionChecker(leader, inproc_peer_fetcher(
+            lambda: _servers(cluster)))
+        ck.periodic_check()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if AlarmType.CORRUPT in leader.alarms.active_types():
+                break
+            time.sleep(0.05)
+        assert AlarmType.CORRUPT in leader.alarms.active_types()
+        leader.alarm(AlarmRequest(
+            action=AlarmAction.DEACTIVATE, member_id=victim.id,
+            alarm=AlarmType.CORRUPT))
+        assert AlarmType.CORRUPT not in leader.alarms.active_types()
+        leader.put(PutRequest(key=b"recovered", value=b"1"))  # unfenced
+
+    def test_single_deviant_peer_blamed_in_two_member_cluster(
+            self, tmp_path):
+        """No majority inversion with one peer: the divergent follower
+        is blamed, not the healthy leader."""
+        from etcd_tpu.server.api import PutRequest
+
+        c = IntegrationCluster(str(tmp_path), n=2)
+        try:
+            leader = c.wait_leader().server
+            leader.put(PutRequest(key=b"k", value=b"v"))
+            self._wait_applied(c, leader)
+            victim = next(s for s in _servers(c).values()
+                          if s.id != leader.id)
+            _corrupt_backend(victim)
+            raised = []
+            ck = CorruptionChecker(leader, inproc_peer_fetcher(
+                lambda: _servers(c)))
+            ck._alarm_corrupt = lambda mid: raised.append(mid)
+            ck.periodic_check()
+            assert raised == [victim.id]
+        finally:
+            c.close()
+
+    def test_unreachable_peers_skipped(self, cluster):
+        leader = cluster.wait_leader().server
+        ck = CorruptionChecker(leader, lambda pid: None)
+        ck.initial_check()
+        ck.periodic_check()  # no peers answer → no alarm, no raise
+
+    @staticmethod
+    def _wait_applied(cluster, leader, timeout=10.0):
+        """Wait until every member applied the leader's last index."""
+        want = leader.applied_index()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(s.applied_index() >= want
+                   for s in _servers(cluster).values()):
+                return
+            time.sleep(0.02)
+        raise AssertionError("cluster did not converge")
+
+
+def test_transport_control_channel_hash_exchange(tmp_path):
+    """The peer-listener hash-KV exchange used by the embed wiring."""
+    from etcd_tpu.transport.tcp import TCPTransport
+
+    t1 = TCPTransport(member_id=1, cluster_id=5)
+    t2 = TCPTransport(member_id=2, cluster_id=5)
+    try:
+        t2.set_hash_provider(lambda: (0xABC, 42, 7))
+        t1.add_peer(2, t2.addr)
+        out = t1.peer_hash_kv(2)
+        assert out == {"member_id": 2, "hash": 0xABC,
+                       "revision": 42, "compact_revision": 7}
+        # Unknown peer → None
+        assert t1.peer_hash_kv(99) is None
+    finally:
+        t1.stop()
+        t2.stop()
+
+
+def test_embed_periodic_corruption_monitor(tmp_path):
+    """End-to-end: embedded 1-member cluster with the monitor on; the
+    monitor runs against zero peers without error, and the transport
+    answers hash queries."""
+    from etcd_tpu.embed import Config, start_etcd
+
+    cfg = Config(
+        name="m0",
+        data_dir=str(tmp_path),
+        listen_peer_urls="http://127.0.0.1:0",
+        listen_client_urls="http://127.0.0.1:0",
+        initial_cluster="m0=http://127.0.0.1:0",
+        initial_corrupt_check=True,
+        corrupt_check_time=0.2,
+    )
+    e = start_etcd(cfg)
+    try:
+        deadline = time.monotonic() + 20
+        while not e.server.is_leader() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert e.server.is_leader()
+        assert e.server.corruption_checker is not None
+        time.sleep(0.5)  # a few monitor passes
+        assert AlarmType.CORRUPT not in e.server.alarms.active_types()
+    finally:
+        e.close()
