@@ -60,10 +60,13 @@ class MultiRaftEngine:
         campaign_mask: Optional[jnp.ndarray] = None,
         propose_n: Optional[jnp.ndarray] = None,
         isolate: Optional[jnp.ndarray] = None,
+        transfer_to: Optional[jnp.ndarray] = None,
+        read_req: Optional[jnp.ndarray] = None,
     ) -> None:
         """One round: deliver pending messages, optionally tick every
-        instance, append proposals on leaders, route the outbox.
-        `isolate` cuts instances off the network for this round."""
+        instance, run host control ops (leader transfer, ReadIndex),
+        append proposals on leaders, route the outbox. `isolate` cuts
+        instances off the network for this round."""
         ticks = (
             jnp.ones_like(self._zeros_b) if tick else self._zeros_b
         )
@@ -71,7 +74,8 @@ class MultiRaftEngine:
         props = propose_n if propose_n is not None else self._zeros_i
         iso = isolate if isolate is not None else self._zeros_b
         self.state, outbox = self._step(
-            self.state, self.inbox, ticks, camp, props, iso
+            self.state, self.inbox, ticks, camp, props, iso,
+            transfer_to, read_req,
         )
         self.inbox = route(self.cfg, outbox)
 
@@ -87,11 +91,52 @@ class MultiRaftEngine:
 
     def campaign(self, instance_ids) -> None:
         mask = self._zeros_b.at[jnp.asarray(instance_ids)].set(True)
-        self.state, outbox = self._step(
-            self.state, self.inbox, self._zeros_b, mask, self._zeros_i,
-            self._zeros_b,
+        self.step_round(campaign_mask=mask)
+
+    def transfer_leader(self, leader_instance: int, target_slot: int) -> None:
+        """Ask the leader instance to hand leadership to target_slot
+        (ref: raft.go:1339 MsgTransferLeader on the leader)."""
+        tr = self._zeros_i.at[leader_instance].set(target_slot + 1)
+        self.step_round(transfer_to=tr)
+
+    def read_index(self, instance_ids) -> None:
+        """Open a ReadIndex batch on the given leader instances
+        (ref: v3_server.go sendReadIndex → MsgReadIndex)."""
+        req = self._zeros_b.at[jnp.asarray(instance_ids)].set(True)
+        self.step_round(read_req=req)
+
+    def read_states(self) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
+        """(seq, index, ready) per instance — the ReadState watermarks
+        the host read loop waits on (ref: read_only.go advance →
+        Ready.ReadStates)."""
+        return (
+            np.asarray(self.state.read_seq),
+            np.asarray(self.state.read_index),
+            np.asarray(self.state.read_ready),
         )
-        self.inbox = route(self.cfg, outbox)
+
+    def set_membership(self, group: int, voters, voters_out=(),
+                       learners=(), joint: bool = False) -> None:
+        """Upload new membership masks for every replica row of `group`
+        — the confchange apply point (ref: confchange/confchange.go
+        EnterJoint/LeaveJoint/Simple; the host Changer computes the
+        slot sets, the device only sees masks)."""
+        r = self.cfg.num_replicas
+        rows = jnp.arange(group * r, (group + 1) * r)
+
+        def mask(slots) -> jnp.ndarray:
+            slots = list(slots)  # materialize once: iterators welcome
+            m = jnp.zeros((r,), bool)
+            return m.at[jnp.asarray(slots, I32)].set(True) if slots else m
+
+        vin, vout, lrn = mask(voters), mask(voters_out), mask(learners)
+        st = self.state
+        self.state = st._replace(
+            voter=st.voter.at[rows].set(vin),
+            voter_out=st.voter_out.at[rows].set(vout),
+            learner=st.learner.at[rows].set(lrn),
+            in_joint=st.in_joint.at[rows].set(bool(joint)),
+        )
 
     # -- observation (device → host gathers, debug/Ready watermarks) ----------
 

@@ -54,6 +54,38 @@ def vote_result(votes: jnp.ndarray, voter: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(won, VOTE_WON, jnp.where(pending, VOTE_PENDING, VOTE_LOST))
 
 
+def joint_committed(
+    match: jnp.ndarray,
+    voter: jnp.ndarray,
+    voter_out: jnp.ndarray,
+    in_joint: jnp.ndarray,
+) -> jnp.ndarray:
+    """Joint-config commit index = min over both halves
+    (ref: raft/quorum/joint.go:49-56)."""
+    main = quorum_committed(match, voter)
+    return jnp.where(
+        in_joint,
+        jnp.minimum(main, quorum_committed(match, voter_out)),
+        main,
+    )
+
+
+def joint_vote_result(
+    votes: jnp.ndarray,
+    voter: jnp.ndarray,
+    voter_out: jnp.ndarray,
+    in_joint: jnp.ndarray,
+) -> jnp.ndarray:
+    """Joint vote result (ref: raft/quorum/joint.go:61-75): lost if
+    either half lost, pending if either half pending, else won."""
+    a = vote_result(votes, voter)
+    b = jnp.where(in_joint, vote_result(votes, voter_out), VOTE_WON)
+    lost = (a == VOTE_LOST) | (b == VOTE_LOST)
+    pending = (a == VOTE_PENDING) | (b == VOTE_PENDING)
+    return jnp.where(lost, VOTE_LOST,
+                     jnp.where(pending, VOTE_PENDING, VOTE_WON))
+
+
 def term_at(
     log_term: jnp.ndarray,
     snap_index: jnp.ndarray,

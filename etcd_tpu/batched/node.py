@@ -103,6 +103,12 @@ class BatchedNode:
         self._inbound_snaps: Dict[int, Snapshot] = {}
         # Host-side proposal forwards waiting for the next Ready.
         self._fwd: List[Message] = []
+        # ReadIndex waiters not yet bound to a device batch, and the
+        # per-batch bindings (seq -> waiters). A waiter is only ever
+        # served by a batch that opened at-or-after its request, so the
+        # confirmed index covers its request time (linearizability).
+        self._read_unbound: List[bytes] = []
+        self._read_bound: Dict[int, List[bytes]] = {}
 
     # -- Node interface --------------------------------------------------------
 
@@ -151,6 +157,13 @@ class BatchedNode:
         return ConfState(voters=list(self.peers))
 
     def step(self, m: Message) -> None:
+        if m.type == MessageType.MsgTransferLeader:
+            # Forwarded from a follower: from_ carries the transferee
+            # (raft.go stepLeader MsgTransferLeader convention).
+            if self.rn.is_leader(0):
+                self.rn.transfer_leader(0, m.from_ - 1)
+                self._work.set()
+            return
         if m.type == MessageType.MsgProp:
             # Forwarded proposal: accept if we lead, else re-forward once
             # more toward our view of the leader; drop without one.
@@ -180,13 +193,38 @@ class BatchedNode:
         self._work.set()
 
     def read_index(self, rctx: bytes) -> None:
-        raise NotImplementedError(
-            "ReadIndex on the batched backend lands with the host-bridge "
-            "work"
-        )
+        """Open (or join) a ReadIndex batch on the device; the
+        confirmed index surfaces as Ready.read_states carrying `rctx`
+        (ref: node.go:556-560 ReadIndex; batching matches the server's
+        linearizableReadLoop one-round-many-waiters shape).
+
+        Raises on a non-leader so callers retry against the leader
+        instead of hanging (divergence from the reference, which
+        forwards MsgReadIndex — the server read loop's retry/timeout
+        machinery handles both shapes)."""
+        if not self.rn.is_leader(0):
+            raise ProposalDroppedError("read_index: not leader")
+        with self._lock:
+            self._read_unbound.append(rctx)
+        self.rn.read_index(0)
+        self._work.set()
 
     def transfer_leadership(self, lead: int, transferee: int) -> None:
-        raise NotImplementedError
+        """ref: node.go:550-554 TransferLeadership. A non-leader
+        forwards to its known leader over the wire, the reference's
+        stepFollower MsgTransferLeader path (raft.go:1457-1464)."""
+        if self.rn.is_leader(0):
+            self.rn.transfer_leader(0, transferee - 1)
+        else:
+            lead_now = self.rn.lead(0)
+            if lead_now == 0:
+                return  # no leader; drop like the reference logs+drops
+            with self._lock:
+                self._fwd.append(Message(
+                    type=MessageType.MsgTransferLeader, to=lead_now,
+                    from_=transferee,
+                ))
+        self._work.set()
 
     def report_unreachable(self, vid: int) -> None:
         pass
@@ -255,6 +293,22 @@ class BatchedNode:
             messages.extend(self._fwd)
             self._fwd.clear()
 
+        read_states = []
+        if rd.read_opened or rd.read_states:
+            from ..raft.read_only import ReadState
+
+            with self._lock:
+                # Bind unbound waiters to the batch that just opened:
+                # it captured a commit index ≥ their request time.
+                for _row, seq in rd.read_opened:
+                    self._read_bound.setdefault(seq, []).extend(
+                        self._read_unbound)
+                    self._read_unbound = []
+                for _row, seq, ridx in rd.read_states:
+                    for rctx in self._read_bound.pop(seq, []):
+                        read_states.append(
+                            ReadState(index=ridx, request_ctx=rctx))
+
         hs = HardState(
             term=int(self.rn._round[0][0]),
             vote=int(self.rn._round[1][0]),
@@ -267,6 +321,7 @@ class BatchedNode:
             committed_entries=committed,
             messages=messages,
             must_sync=rd.must_sync,
+            read_states=read_states,
         )
         return rd_out
 

@@ -61,6 +61,7 @@ from .step import (
     T_PREVOTE,
     T_PREVOTE_RESP,
     T_SNAP,
+    T_TIMEOUT_NOW,
     T_VOTE,
     T_VOTE_RESP,
     MsgSlots,
@@ -75,6 +76,7 @@ _LANE = {
     T_APP: KIND_APP,
     T_SNAP: KIND_APP,
     T_HB: KIND_HB,
+    T_TIMEOUT_NOW: KIND_HB,
     T_VOTE_RESP: KIND_VOTE_RESP,
     T_PREVOTE_RESP: KIND_VOTE_RESP,
     T_APP_RESP: KIND_APP_RESP,
@@ -111,11 +113,18 @@ class BatchedReady:
     # (row, [(index, term, data or None for internal/empty)])
     messages: List[Tuple[int, Message]]
     must_sync: bool
+    # Quorum-confirmed ReadIndex batches this round: (row, seq, index)
+    # (ref: Ready.ReadStates, read_only.go advance).
+    read_states: List[Tuple[int, int, int]] = field(default_factory=list)
+    # Batches that OPENED this round: (row, seq). Hosts bind waiters to
+    # the open batch so a later waiter is never served an earlier
+    # batch's (stale) index.
+    read_opened: List[Tuple[int, int]] = field(default_factory=list)
 
     def contains_updates(self) -> bool:
         return bool(
             self.hardstates or self.entries or self.snapshots
-            or self.committed or self.messages
+            or self.committed or self.messages or self.read_states
         )
 
 
@@ -183,6 +192,10 @@ class BatchedRawNode:
         self._ticks = np.zeros(self.n, np.int64)
         self._campaign = np.zeros(self.n, bool)
         self._isolate = np.zeros(self.n, bool)
+        self._transfer = np.zeros(self.n, np.int32)  # target slot+1
+        self._read_req = np.zeros(self.n, bool)
+        self._read_seen = np.zeros(self.n, np.int64)  # last surfaced seq
+        self._read_seq_prev = np.zeros(self.n, np.int64)  # open detection
         self._snap_staged: Dict[int, Tuple[int, int]] = {}  # row->(idx,term)
 
         if restore:
@@ -266,6 +279,19 @@ class BatchedRawNode:
         with self._lock:
             self._props[row].append(data)
 
+    def transfer_leader(self, row: int, target_slot: int) -> None:
+        """Stage a leadership handoff request on a leader row
+        (ref: raft.go:1339 MsgTransferLeader; device _control phase)."""
+        with self._lock:
+            self._transfer[row] = target_slot + 1
+
+    def read_index(self, row: int) -> None:
+        """Stage a ReadIndex batch request on a leader row; the
+        confirmed (seq, index) surfaces in BatchedReady.read_states
+        (ref: raft.go:1078 MsgReadIndex → Ready.ReadStates)."""
+        with self._lock:
+            self._read_req[row] = True
+
     def pending_proposals(self, row: int) -> int:
         with self._lock:
             return len(self._props[row])
@@ -321,6 +347,8 @@ class BatchedRawNode:
                 self._pending
                 or self._ticks.any()
                 or self._campaign.any()
+                or self._transfer.any()
+                or self._read_req.any()
                 or any(self._props[i] and self.m_role[i] == LEADER
                        for i in range(self.n))
             )
@@ -339,6 +367,10 @@ class BatchedRawNode:
             camp = self._campaign.copy()
             self._campaign[:] = False
             iso = self._isolate.copy()
+            transfer = self._transfer.copy()
+            self._transfer[:] = 0
+            read_req = self._read_req.copy()
+            self._read_req[:] = False
             props_n = np.fromiter(
                 (min(len(q), cfg.max_props_per_round) for q in self._props),
                 np.int32, count=self.n,
@@ -348,14 +380,18 @@ class BatchedRawNode:
             self.state, inbox,
             jnp.asarray(ticks), jnp.asarray(camp),
             jnp.asarray(props_n), jnp.asarray(iso),
+            jnp.asarray(transfer), jnp.asarray(read_req),
         )
         self.state = st
 
         # One bulk device→host transfer.
         (term, vote, commit, last, role, lead, snap_i, snap_t, ring,
-         last_tick) = jax.device_get([
+         rd_seq, rd_idx, rd_ready,
+         mid_seq, mid_idx, mid_ready, last_tick) = jax.device_get([
             st.term, st.vote, st.commit, st.last, st.role, st.lead,
             st.snap_index, st.snap_term, st.log_term,
+            st.read_seq, st.read_index, st.read_ready,
+            aux.read_seq, aux.read_index, aux.read_ready,
             aux.last_tick,
         ])
         out_np = jax.device_get(outbox)
@@ -458,6 +494,26 @@ class BatchedRawNode:
             )
         )
 
+        # Batches opened this round, then newly quorum-confirmed ones
+        # (each surfaces exactly once; ref: read_only.go advance →
+        # Ready.ReadStates).
+        read_opened: List[Tuple[int, int]] = []
+        for row in np.nonzero(rd_seq > self._read_seq_prev)[0]:
+            read_opened.append((int(row), int(rd_seq[row])))
+            self._read_seq_prev[row] = int(rd_seq[row])
+        read_states: List[Tuple[int, int, int]] = []
+        # Mid-round confirmations first (a latched reopen in _control
+        # may have already replaced them in the end-of-round state).
+        for row in np.nonzero(mid_ready & (mid_seq > self._read_seen))[0]:
+            read_states.append(
+                (int(row), int(mid_seq[row]), int(mid_idx[row])))
+            self._read_seen[row] = int(mid_seq[row])
+        newly = np.nonzero(rd_ready & (rd_seq > self._read_seen))[0]
+        for row in newly:
+            read_states.append(
+                (int(row), int(rd_seq[row]), int(rd_idx[row])))
+            self._read_seen[row] = int(rd_seq[row])
+
         self._round = (term, vote, commit, last, role, lead,
                        snap_i.astype(np.int64), ring64)
         return BatchedReady(
@@ -467,6 +523,8 @@ class BatchedRawNode:
             committed=committed,
             messages=messages,
             must_sync=must_sync,
+            read_states=read_states,
+            read_opened=read_opened,
         )
 
     def advance(self) -> None:
@@ -508,6 +566,7 @@ class BatchedRawNode:
         reject = np.zeros(shape, bool)
         reject_hint = np.zeros(shape, np.int32)
         n_ents = np.zeros(shape, np.int32)
+        ctx = np.zeros(shape, np.int32)
         ent_terms = np.zeros(shape + (e,), np.int32)
         consumed = 0
         dead = []
@@ -526,6 +585,8 @@ class BatchedRawNode:
             reject[row, s, lane] = m.reject
             reject_hint[row, s, lane] = m.reject_hint
             n_ents[row, s, lane] = len(m.entries)
+            if len(m.context) == 4:
+                ctx[row, s, lane] = int.from_bytes(m.context, "little")
             for j, ent in enumerate(m.entries[:e]):
                 ent_terms[row, s, lane, j] = ent.term
         for key in dead:
@@ -535,7 +596,8 @@ class BatchedRawNode:
             term=jnp.asarray(term), log_term=jnp.asarray(log_term),
             index=jnp.asarray(index), commit=jnp.asarray(commit),
             reject=jnp.asarray(reject), reject_hint=jnp.asarray(reject_hint),
-            n_ents=jnp.asarray(n_ents), ent_terms=jnp.asarray(ent_terms),
+            n_ents=jnp.asarray(n_ents), ctx=jnp.asarray(ctx),
+            ent_terms=jnp.asarray(ent_terms),
         )
         return inbox, consumed
 
@@ -557,6 +619,11 @@ class BatchedRawNode:
                 reject=bool(out.reject[row, tgt, k]),
                 reject_hint=int(out.reject_hint[row, tgt, k]),
             )
+            cw = int(out.ctx[row, tgt, k])
+            if cw:
+                # The device ctx word travels as 4 context bytes
+                # (the reference's Message.Context).
+                m.context = cw.to_bytes(4, "little")
             ne = int(out.n_ents[row, tgt, k])
             if t == T_APP and ne:
                 ents = []

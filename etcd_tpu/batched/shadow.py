@@ -36,6 +36,7 @@ _TYPE_TO_KIND = {
     MessageType.MsgApp: KIND_APP,
     MessageType.MsgSnap: KIND_APP,
     MessageType.MsgHeartbeat: KIND_HB,
+    MessageType.MsgTimeoutNow: KIND_HB,
     MessageType.MsgVoteResp: KIND_VOTE_RESP,
     MessageType.MsgPreVoteResp: KIND_VOTE_RESP,
     MessageType.MsgAppResp: KIND_APP_RESP,
@@ -51,15 +52,19 @@ class ShadowCluster:
         heartbeat_timeout: int = 1,
         max_inflight: int = 1 << 20,
         pre_vote: bool = False,
+        learners: Sequence[int] = (),
     ):
         self.r = num_replicas
         self.nodes: List[RawNode] = []
+        lrn = {s + 1 for s in learners}
         for slot in range(num_replicas):
             storage = MemoryStorage()
             # Bootstrap the full-voter config the way the batched engine
             # does: membership is initial state, not replayed conf changes.
             storage._snapshot.metadata.conf_state = ConfState(
-                voters=list(range(1, num_replicas + 1))
+                voters=[i for i in range(1, num_replicas + 1)
+                        if i not in lrn],
+                learners=sorted(lrn),
             )
             cfg = Config(
                 id=slot + 1,
@@ -85,11 +90,14 @@ class ShadowCluster:
         proposals: Optional[Dict[int, int]] = None,
         tick: bool = False,
         isolate: Iterable[int] = (),
+        transfers: Optional[Dict[int, int]] = None,
     ) -> None:
         """One round with the device's phase order:
-        deliver → tick/campaign → propose → emit."""
+        deliver → tick/campaign → control → propose → emit.
+        `transfers` maps leader slot → target slot."""
         iso = set(isolate)
         proposals = proposals or {}
+        transfers = transfers or {}
 
         # Phase 1: deliver, fixed (kind, sender) order per target — the
         # device processes lane-by-lane with senders ascending within a
@@ -114,6 +122,14 @@ class ShadowCluster:
                 node.tick()
         for slot in campaigns:
             self.nodes[slot].campaign()
+
+        # Phase 2b: host control ops, same slot order as the device's
+        # _control phase (after tick, before propose).
+        for slot, target in transfers.items():
+            try:
+                self.nodes[slot].transfer_leader(target + 1)
+            except RaftError:
+                pass
 
         # Phase 3: proposals (empty payloads; the batched engine carries
         # payloads in the host arena, so terms are the shared content).

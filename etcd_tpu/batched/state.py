@@ -95,15 +95,41 @@ class BatchedState(NamedTuple):
     # Votes (ref: tracker.go Votes): -1 not voted, 0 rejected, 1 granted
     votes: jnp.ndarray  # [N, R] i32
 
-    # Membership: voter mask over replica slots (single majority config;
-    # joint configs add a second mask — ref: quorum/joint.go)
+    # Membership (ref: tracker.Config / quorum/joint.go): incoming
+    # voters, outgoing voters (joint), learners. in_joint gates the
+    # second quorum half. Masks are uploaded by the host at the
+    # confchange apply point (SURVEY §2.1 "host-side control plane").
     voter: jnp.ndarray  # [N, R] bool
+    voter_out: jnp.ndarray  # [N, R] bool (only meaningful when in_joint)
+    learner: jnp.ndarray  # [N, R] bool
+    in_joint: jnp.ndarray  # [N] bool
+
+    # Leader transfer (ref: raft.go:1339-1372; raft.leadTransferee).
+    transferee: jnp.ndarray  # [N] i32, slot+1; 0 = no transfer pending
+    transfer_sent: jnp.ndarray  # [N] bool — TimeoutNow already emitted
+
+    # ReadIndex (ref: read_only.go:39-112, ReadOnlySafe): one pending
+    # read batch per group; heartbeats carry read_seq as ctx, acks
+    # accumulate until quorum.
+    read_seq: jnp.ndarray  # [N] i32, incremented per accepted batch
+    read_index: jnp.ndarray  # [N] i32, commit at request time; -1 none
+    read_acks: jnp.ndarray  # [N, R] bool
+    read_ready: jnp.ndarray  # [N] bool — quorum confirmed for read_seq
+    # Request latch: a read asked for while a batch is in flight (or
+    # before first commit-in-term) opens the next batch as soon as the
+    # current one confirms — the device form of read_only.go's pending
+    # queue (requests are never dropped).
+    read_req_latch: jnp.ndarray  # [N] bool
 
     # Pending send flags consumed by the emit phase.
     send_append: jnp.ndarray  # [N, R] bool
     send_heartbeat: jnp.ndarray  # [N, R] bool
     send_vote_req: jnp.ndarray  # [N] bool
     vote_req_is_pre: jnp.ndarray  # [N] bool
+    # Vote requests carry the transfer-campaign context flag
+    # (ref: raft.go campaignTransfer → ignore leader lease).
+    vote_req_transfer: jnp.ndarray  # [N] bool
+    send_timeout_now: jnp.ndarray  # [N] bool (target = transferee)
 
 
 def _slot_ids(cfg: BatchedConfig) -> np.ndarray:
@@ -161,9 +187,21 @@ def init_state(cfg: BatchedConfig, start_index: int = 0,
         inflight=jnp.zeros((n, r), I32),
         votes=jnp.full((n, r), -1, I32),
         voter=jnp.ones((n, r), bool),
+        voter_out=jnp.zeros((n, r), bool),
+        learner=jnp.zeros((n, r), bool),
+        in_joint=jnp.zeros((n,), bool),
+        transferee=zeros_n,
+        transfer_sent=jnp.zeros((n,), bool),
+        read_seq=zeros_n,
+        read_index=jnp.full((n,), -1, I32),
+        read_acks=jnp.zeros((n, r), bool),
+        read_ready=jnp.zeros((n,), bool),
+        read_req_latch=jnp.zeros((n,), bool),
         send_append=jnp.zeros((n, r), bool),
         send_heartbeat=jnp.zeros((n, r), bool),
         send_vote_req=jnp.zeros((n,), bool),
         vote_req_is_pre=jnp.zeros((n,), bool),
+        vote_req_transfer=jnp.zeros((n,), bool),
+        send_timeout_now=jnp.zeros((n,), bool),
     )
     return st

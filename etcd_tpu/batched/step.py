@@ -34,10 +34,10 @@ from .kernels import (
     VOTE_LOST,
     VOTE_WON,
     find_conflict_by_term,
-    quorum_committed,
+    joint_committed,
+    joint_vote_result,
     ring_write,
     term_at,
-    vote_result,
 )
 from .state import (
     CANDIDATE,
@@ -65,6 +65,7 @@ T_APP, T_APP_RESP = 3, 4
 T_VOTE, T_VOTE_RESP = 5, 6
 T_SNAP = 7
 T_HB, T_HB_RESP = 8, 9
+T_TIMEOUT_NOW = 14
 T_PREVOTE, T_PREVOTE_RESP = 17, 18
 
 
@@ -81,6 +82,10 @@ class MsgSlots(NamedTuple):
     reject: jnp.ndarray  # bool
     reject_hint: jnp.ndarray  # i32
     n_ents: jnp.ndarray  # i32
+    # Context word (the reference's Message.Context bytes, reduced to
+    # what rides it: campaign-transfer flag on votes, read_seq on
+    # heartbeats/acks — ref: raft.go campaignTransfer, read_only.go ctx).
+    ctx: jnp.ndarray  # i32
     ent_terms: jnp.ndarray  # i32 [..., E]
 
 
@@ -96,6 +101,7 @@ def empty_msgs(shape: Tuple[int, ...], num_ents: int) -> MsgSlots:
         reject=jnp.zeros(shape, bool),
         reject_hint=z,
         n_ents=z,
+        ctx=z,
         ent_terms=jnp.zeros(shape + (num_ents,), I32),
     )
 
@@ -140,6 +146,14 @@ def _reset(cfg: BatchedConfig, st: BatchedState, iid, slot, term) -> BatchedStat
         pending_snapshot=jnp.zeros((r,), I32),
         recent_active=jnp.zeros((r,), bool),
         inflight=jnp.zeros((r,), I32),
+        # abortLeaderTransfer + read state dies with the term/role
+        # (ref: raft.go:590-619 reset).
+        transferee=jnp.zeros_like(st.transferee),
+        transfer_sent=jnp.zeros_like(st.transfer_sent),
+        read_index=jnp.full_like(st.read_index, -1),
+        read_acks=jnp.zeros((r,), bool),
+        read_ready=jnp.zeros_like(st.read_ready),
+        read_req_latch=jnp.zeros_like(st.read_req_latch),
     )
 
 
@@ -165,12 +179,24 @@ def _append_own(cfg: BatchedConfig, st: BatchedState, slot, n) -> BatchedState:
 
 def _maybe_commit(st: BatchedState) -> BatchedState:
     """Quorum commit-index advancement — THE replica-axis reduction
-    (ref: raft.go:585-588 + quorum/majority.go:126)."""
-    mci = quorum_committed(st.match, st.voter)
+    (ref: raft.go:585-588 + quorum/majority.go:126, joint.go:49-56)."""
+    mci = joint_committed(st.match, st.voter, st.voter_out, st.in_joint)
     ok = (mci > st.commit) & (
         term_at(st.log_term, st.snap_index, st.snap_term, st.last, mci) == st.term
     )
     return st._replace(commit=jnp.where(ok, mci, st.commit))
+
+
+def _repl_targets(st: BatchedState) -> jnp.ndarray:
+    """[R] replication set: every tracked progress — voters of both
+    configs plus learners (ref: tracker.go Visit over the full
+    progress map)."""
+    return st.voter | st.voter_out | st.learner
+
+
+def _vote_targets(st: BatchedState) -> jnp.ndarray:
+    """[R] electorate: voters of both halves, never learners."""
+    return st.voter | st.voter_out
 
 
 def _become_leader(cfg, st, iid, slot) -> BatchedState:
@@ -195,11 +221,14 @@ def _record_vote_and_tally(st: BatchedState, from_slot, granted):
         (peers == from_slot) & (st.votes == -1), new_vote, st.votes
     )
     st = st._replace(votes=votes)
-    return st, vote_result(votes, st.voter)
+    return st, joint_vote_result(votes, st.voter, st.voter_out, st.in_joint)
 
 
-def _campaign(cfg: BatchedConfig, st: BatchedState, iid, slot, pre) -> BatchedState:
-    """ref: raft.go:785-835; `pre` is a static bool (config.pre_vote)."""
+def _campaign(cfg: BatchedConfig, st: BatchedState, iid, slot, pre,
+              transfer: bool = False) -> BatchedState:
+    """ref: raft.go:785-835; `pre`/`transfer` are static bools
+    (config.pre_vote; campaignTransfer skips pre-vote and marks its
+    vote requests to pierce leader leases)."""
     if pre:
         # becomePreCandidate: no term bump, no vote change.
         st1 = st._replace(
@@ -222,6 +251,7 @@ def _campaign(cfg: BatchedConfig, st: BatchedState, iid, slot, pre) -> BatchedSt
     st_lost = st1._replace(
         send_vote_req=jnp.ones_like(st.send_vote_req),
         vote_req_is_pre=jnp.full_like(st.vote_req_is_pre, pre),
+        vote_req_transfer=jnp.full_like(st.vote_req_transfer, transfer),
     )
     return _sel(won, st_won, st_lost)
 
@@ -261,7 +291,9 @@ def _term_gate(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
         & (st.lead != 0)
         & (st.election_elapsed < cfg.election_timeout)
     )
-    ignore_lease = higher & is_vote_req & in_lease
+    # Transfer-campaign votes pierce the lease (ref: raft.go:870-880
+    # force = Context == campaignTransfer).
+    ignore_lease = higher & is_vote_req & in_lease & ~(m.ctx == 1)
 
     keep_term = (m.type == T_PREVOTE) | ((m.type == T_PREVOTE_RESP) & ~m.reject)
     do_become = higher & ~keep_term & ~ignore_lease
@@ -368,7 +400,8 @@ def _lane_app(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
 
 def _lane_hb(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
              from_slot):
-    """Lane KIND_HB: T_HB (ref: raft.go:1513)."""
+    """Lane KIND_HB: T_HB + T_TIMEOUT_NOW (ref: raft.go:1513;
+    :1465-1472 MsgTimeoutNow → immediate transfer campaign)."""
     no_resp = empty_msgs((), cfg.max_ents_per_msg)
     st1, dead, lower = _term_gate(cfg, iid, slot, st, m, from_slot)
 
@@ -377,13 +410,22 @@ def _lane_hb(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
         commit=jnp.maximum(fol.commit, jnp.minimum(m.commit, fol.last))
     )
     hb_resp = no_resp._replace(
-        valid=True, type=jnp.asarray(T_HB_RESP, I32), term=fol.term
+        valid=True, type=jnp.asarray(T_HB_RESP, I32), term=fol.term,
+        ctx=m.ctx,  # ReadIndex ack context echo (read_only.go recvAck)
     )
     leader_traffic_ok = st1.role != LEADER
-    st_live = _sel(leader_traffic_ok, st_hb, st1)
-    resp_live = _sel(leader_traffic_ok, hb_resp, no_resp)
 
-    stale = lower & jnp.asarray(cfg.check_quorum or cfg.pre_vote)
+    # MsgTimeoutNow: campaign at once regardless of timers; only
+    # promotable instances honor it (raft.go:1465-1472 + hup gating).
+    is_ton = m.type == T_TIMEOUT_NOW
+    promotable = _vote_targets(st1)[slot]
+    st_ton = _campaign(cfg, st1, iid, slot, False, transfer=True)
+
+    st_live = _sel(leader_traffic_ok,
+                   _sel(is_ton & promotable, st_ton, st_hb), st1)
+    resp_live = _sel(leader_traffic_ok & ~is_ton, hb_resp, no_resp)
+
+    stale = lower & jnp.asarray(cfg.check_quorum or cfg.pre_vote) & ~is_ton
     resp_stale = no_resp._replace(
         valid=stale, type=jnp.asarray(T_APP_RESP, I32), term=st.term
     )
@@ -523,7 +565,7 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
     r = st.match.shape[-1]
     peers = jnp.arange(r, dtype=I32)
     at_s = peers == s
-    prog_ok = st.voter[s]  # progress exists (voters only in v1)
+    prog_ok = _repl_targets(st)[s]  # progress exists for voters+learners
 
     st = st._replace(recent_active=jnp.where(at_s, True, st.recent_active))
 
@@ -594,7 +636,7 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
     st_acc = st_acc._replace(
         send_append=jnp.where(
             advanced,
-            st_acc.send_append | st_acc.voter,
+            st_acc.send_append | _repl_targets(st_acc),
             st_acc.send_append | (at_s & (old_paused | more)),
         )
     )
@@ -605,7 +647,8 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
 
 
 def _leader_hb_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
-    """ref: raft.go:1284-1309 (ReadIndex ack bookkeeping is host-side)."""
+    """ref: raft.go:1284-1309, incl. the ReadIndex ack path
+    (read_only.go:68 recvAck + :81 advance, on-device)."""
     r = st.match.shape[-1]
     peers = jnp.arange(r, dtype=I32)
     at_s = peers == s
@@ -620,7 +663,20 @@ def _leader_hb_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
         ),
         send_append=st.send_append | (at_s & (st.match < st.last)),
     )
-    return _sel(st.voter[s], st2, st)
+    # ReadIndex ack: a heartbeat response echoing the pending read's
+    # ctx counts toward its quorum; quorum → read_ready.
+    pending = (st2.read_index >= 0) & ~st2.read_ready
+    ack = pending & (m.ctx == st2.read_seq) & (m.ctx > 0)
+    acks = st2.read_acks | (at_s & ack)
+    votes = jnp.where(acks, 1, -1)
+    confirmed = joint_vote_result(
+        votes, st2.voter, st2.voter_out, st2.in_joint
+    ) == VOTE_WON
+    st2 = st2._replace(
+        read_acks=acks,
+        read_ready=st2.read_ready | (pending & confirmed),
+    )
+    return _sel(_repl_targets(st)[s], st2, st)
 
 
 def _candidate_vote_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
@@ -633,7 +689,7 @@ def _candidate_vote_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
     else:
         st_won_pre = st2
     st_won_real = _become_leader(cfg, st2, iid, slot)
-    peers_mask = st_won_real.voter & (
+    peers_mask = _repl_targets(st_won_real) & (
         jnp.arange(st.match.shape[-1], dtype=I32) != slot
     )
     st_won_real = st_won_real._replace(
@@ -708,7 +764,11 @@ def _tick(cfg: BatchedConfig, iid, slot, st: BatchedState, do_tick,
         election_elapsed=jnp.where(cq_fire, 0, ee),
         heartbeat_elapsed=jnp.where(hb_fire, 0, he),
         send_heartbeat=st.send_heartbeat
-        | (hb_fire & st.voter & (peers != slot)),
+        | (hb_fire & _repl_targets(st) & (peers != slot)),
+        # A transfer that outlives one election timeout is aborted
+        # (ref: raft.go:670-678 tickHeartbeat abortLeaderTransfer).
+        transferee=jnp.where(cq_fire, 0, st.transferee),
+        transfer_sent=jnp.where(cq_fire, False, st.transfer_sent),
     )
     if cfg.check_quorum:
         # Leader self-check every election timeout: step down when a
@@ -716,7 +776,9 @@ def _tick(cfg: BatchedConfig, iid, slot, st: BatchedState, do_tick,
         # (ref: raft.go:997-1018 MsgCheckQuorum).
         active = jnp.where(peers == slot, True, st1.recent_active)
         votes = jnp.where(active, 1, 0)
-        alive = vote_result(votes, st1.voter) == VOTE_WON
+        alive = joint_vote_result(
+            votes, st1.voter, st1.voter_out, st1.in_joint
+        ) == VOTE_WON
         st_down = _become_follower(cfg, st1, iid, slot, st1.term, 0)
         st1 = _sel(cq_fire & ~alive, st_down, st1)
         st1 = st1._replace(
@@ -725,16 +787,82 @@ def _tick(cfg: BatchedConfig, iid, slot, st: BatchedState, do_tick,
             )
         )
 
-    # Follower/candidate election firing.
-    promotable = st.voter[slot]
+    # Follower/candidate election firing (hup gated on promotability —
+    # learners never campaign, ref: raft.go:760-784).
+    promotable = _vote_targets(st)[slot]
     fire = (
-        (~is_leader & promotable & (ee >= st.randomized_timeout)) | do_campaign
-    ) & (st.role != LEADER)
+        (~is_leader & (ee >= st.randomized_timeout)) | do_campaign
+    ) & promotable & (st.role != LEADER)
     st1 = st1._replace(
         election_elapsed=jnp.where(fire & ~is_leader, 0, st1.election_elapsed)
     )
     st_camp = _campaign(cfg, st1, iid, slot, cfg.pre_vote)
     return _sel(fire, st_camp, st1)
+
+
+def _control(cfg: BatchedConfig, slot, st: BatchedState, transfer_to,
+             read_req):
+    """Host control plane: leader-transfer requests and ReadIndex
+    rounds (ref: raft.go:1339-1372 stepLeader MsgTransferLeader;
+    raft.go:1078-1096 MsgReadIndex + read_only.go addRequest).
+
+    `transfer_to` is slot+1 (0 = none); `read_req` asks the leader to
+    open a read batch at its current commit index. Both are no-ops on
+    non-leaders (the host routes requests to the leader instance)."""
+    r = cfg.num_replicas
+    peers = jnp.arange(r, dtype=I32)
+    is_leader = st.role == LEADER
+
+    # --- leader transfer -----------------------------------------------------
+    target = transfer_to - 1
+    valid_target = (
+        is_leader
+        & (transfer_to > 0)
+        & (transfer_to != slot + 1)          # self-transfer is a no-op
+        & (transfer_to != st.transferee)     # dup request ignored
+        & _vote_targets(st)[jnp.clip(target, 0, r - 1)]  # learners can't lead
+    )
+    st_tr = st._replace(
+        transferee=transfer_to,
+        transfer_sent=jnp.zeros_like(st.transfer_sent),
+        election_elapsed=jnp.zeros_like(st.election_elapsed),
+        # Last-chance catch-up append (raft.go:1367-1371 sendAppend).
+        send_append=st.send_append
+        | ((peers == target) & (st.match[jnp.clip(target, 0, r - 1)]
+                                < st.last)),
+    )
+    st = _sel(valid_target, st_tr, st)
+
+    # --- ReadIndex -----------------------------------------------------------
+    # Leader must have committed in its own term before serving reads
+    # (ref: raft.go:1813-1825 pending queue until first commit), and a
+    # batch in flight must not be clobbered (its in-flight acks would
+    # be orphaned). Unserviceable requests latch and open the next
+    # batch when the blocker clears — read_only.go's pending queue.
+    committed_in_term = (
+        term_at(st.log_term, st.snap_index, st.snap_term, st.last, st.commit)
+        == st.term
+    )
+    batch_pending = (st.read_index >= 0) & ~st.read_ready
+    want = read_req | st.read_req_latch
+    accept = is_leader & want & committed_in_term & ~batch_pending
+    acks0 = peers == slot
+    votes0 = jnp.where(acks0, 1, -1)
+    solo = joint_vote_result(
+        votes0, st.voter, st.voter_out, st.in_joint
+    ) == VOTE_WON
+    st_rd = st._replace(
+        read_seq=st.read_seq + 1,
+        read_index=st.commit,
+        read_acks=acks0,
+        read_ready=solo,  # single-voter group confirms instantly
+        # Confirmation heartbeats to the electorate (bcastHeartbeat-
+        # WithCtx, raft.go:1827-1843); emit stamps ctx = read_seq.
+        send_heartbeat=st.send_heartbeat
+        | (_repl_targets(st) & (peers != slot)),
+    )
+    st = _sel(accept, st_rd, st)
+    return st._replace(read_req_latch=want & ~accept)
 
 
 def _propose(cfg: BatchedConfig, slot, st: BatchedState, n_new):
@@ -743,7 +871,9 @@ def _propose(cfg: BatchedConfig, slot, st: BatchedState, n_new):
     appendEntry → bcastAppend)."""
     r = cfg.num_replicas
     peers = jnp.arange(r, dtype=I32)
-    is_leader = st.role == LEADER
+    # Proposals are dropped while a leadership transfer is in flight
+    # (ref: raft.go:1048-1053 ErrProposalDropped on leadTransferee).
+    is_leader = (st.role == LEADER) & (st.transferee == 0)
     headroom = jnp.maximum(
         cfg.window - (st.last - st.snap_index) - cfg.max_props_per_round, 0
     )
@@ -751,7 +881,8 @@ def _propose(cfg: BatchedConfig, slot, st: BatchedState, n_new):
     n = jnp.minimum(n, headroom)
     st2 = _append_own(cfg, st, slot, n)
     st2 = st2._replace(
-        send_append=st2.send_append | ((n > 0) & st2.voter & (peers != slot))
+        send_append=st2.send_append
+        | ((n > 0) & _repl_targets(st2) & (peers != slot))
     )
     return _sel(n > 0, st2, st)
 
@@ -783,11 +914,13 @@ def _emit(cfg: BatchedConfig, slot, st: BatchedState):
 
     ta = lambda i: term_at(st.log_term, st.snap_index, st.snap_term, st.last, i)
 
-    is_peer = st.voter & (peers != slot)
+    not_self = peers != slot
+    vote_peer = _vote_targets(st) & not_self
+    repl_peer = _repl_targets(st) & not_self
     is_leader = st.role == LEADER
 
     # --- vote requests (ref: raft.go:822-834) ---
-    vr = st.send_vote_req & is_peer
+    vr = st.send_vote_req & vote_peer
     vtype = jnp.where(st.vote_req_is_pre, T_PREVOTE, T_VOTE)
     vterm = jnp.where(st.vote_req_is_pre, st.term + 1, st.term)
     out = out._replace(
@@ -796,21 +929,41 @@ def _emit(cfg: BatchedConfig, slot, st: BatchedState):
         term=out.term.at[:, KIND_VOTE].set(vterm),
         index=out.index.at[:, KIND_VOTE].set(st.last),
         log_term=out.log_term.at[:, KIND_VOTE].set(ta(st.last)),
+        ctx=out.ctx.at[:, KIND_VOTE].set(
+            jnp.where(st.vote_req_transfer, 1, 0)
+        ),
     )
 
-    # --- heartbeats (ref: raft.go:495-511) ---
-    hb = st.send_heartbeat & is_peer & is_leader
+    # --- heartbeats + TimeoutNow (ref: raft.go:495-511; :1367-1372) ---
+    # The pending read's seq rides every confirmation heartbeat
+    # (bcastHeartbeatWithCtx); TimeoutNow to a caught-up transferee
+    # shares the lane (a transfer supersedes that peer's heartbeat).
+    hb = st.send_heartbeat & repl_peer & is_leader
+    pending_read = (st.read_index >= 0) & ~st.read_ready
+    hb_ctx = jnp.where(pending_read, st.read_seq, 0)
+    tr = st.transferee - 1  # valid only when transferee > 0
+    ton = (
+        is_leader
+        & (st.transferee > 0)
+        & ~st.transfer_sent
+        & (st.match[jnp.clip(tr, 0, r - 1)] >= st.last)
+        & (peers == tr)
+    )
     out = out._replace(
-        valid=out.valid.at[:, KIND_HB].set(hb),
-        type=out.type.at[:, KIND_HB].set(T_HB),
+        valid=out.valid.at[:, KIND_HB].set(hb | ton),
+        type=out.type.at[:, KIND_HB].set(
+            jnp.where(ton, T_TIMEOUT_NOW, T_HB)
+        ),
         term=out.term.at[:, KIND_HB].set(st.term),
         commit=out.commit.at[:, KIND_HB].set(
             jnp.minimum(st.match, st.commit)
         ),
+        ctx=out.ctx.at[:, KIND_HB].set(jnp.where(ton, 0, hb_ctx)),
     )
+    st = st._replace(transfer_sent=st.transfer_sent | jnp.any(ton))
 
     # --- appends / snapshots (ref: raft.go:432-492 maybeSendAppend) ---
-    want = st.send_append & is_peer & is_leader & ~_paused(cfg, st)
+    want = st.send_append & repl_peer & is_leader & ~_paused(cfg, st)
     prev = st.next - 1
     snap_needed = prev < st.snap_index
     n_send = jnp.clip(st.last - prev, 0, e)  # [R]
@@ -855,6 +1008,7 @@ def _emit(cfg: BatchedConfig, slot, st: BatchedState):
         send_append=jnp.zeros_like(st.send_append),
         send_heartbeat=jnp.zeros_like(st.send_heartbeat),
         send_vote_req=jnp.zeros_like(st.send_vote_req),
+        vote_req_transfer=jnp.zeros_like(st.vote_req_transfer),
     )
     return st, out
 
@@ -886,13 +1040,22 @@ def route(cfg: BatchedConfig, outbox: MsgSlots) -> MsgSlots:
 
 
 class StepAux(NamedTuple):
-    """Per-instance log watermark captured after the tick phase (just
-    before proposals append): the host assigns its queued proposal
-    payloads to indexes (last_tick, last] — which is what keeps payload
-    bytes off the device (ref: SURVEY.md §7 "payload bytes don't belong
-    on the TPU")."""
+    """Per-instance mid-round snapshots the host needs.
+
+    last_tick: log watermark after the tick phase (just before
+    proposals append) — the host assigns its queued proposal payloads
+    to indexes (last_tick, last], keeping payload bytes off the device
+    (ref: SURVEY.md §7).
+
+    read_*: the ReadIndex state right after delivery — a batch can
+    confirm in the deliver phase and be replaced by a latched reopen in
+    _control within the same round; this snapshot is how that
+    confirmation still reaches Ready.ReadStates."""
 
     last_tick: jnp.ndarray  # [N] last log index pre-propose
+    read_seq: jnp.ndarray  # [N]
+    read_index: jnp.ndarray  # [N]
+    read_ready: jnp.ndarray  # [N]
 
 
 @functools.lru_cache(maxsize=None)
@@ -903,14 +1066,16 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
     compilation per shape)."""
 
     def step_round(st: BatchedState, inbox: MsgSlots, tick_mask, campaign_mask,
-                   propose_n, isolate, iids, slots):
+                   propose_n, isolate, transfer_to, read_req, iids, slots):
         def per_instance(iid, slot, sti, inbox_i, do_tick, do_camp, n_new,
-                         iso):
+                         iso, tr_to, rd_req):
             # Partitioned instances neither receive nor send this round
             # (fault injection; ref: tests/framework bridge & pkg/proxy).
             inbox_i = inbox_i._replace(valid=inbox_i.valid & ~iso)
             sti, req_resps = _deliver_all(cfg, iid, slot, sti, inbox_i)
             sti = _tick(cfg, iid, slot, sti, do_tick, do_camp)
+            read_snap = (sti.read_seq, sti.read_index, sti.read_ready)
+            sti = _control(cfg, slot, sti, tr_to, rd_req)
             last_tick = sti.last
             sti = _propose(cfg, slot, sti, n_new)
             sti, out = _emit(cfg, slot, sti)
@@ -920,7 +1085,7 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
                 lambda o, rr: o.at[:, 3:].set(rr), out, req_resps
             )
             out = out._replace(valid=out.valid & ~iso)
-            return sti, out, StepAux(last_tick)
+            return sti, out, StepAux(last_tick, *read_snap)
 
         if cfg.lanes_minor:
             # Instance axis minor inside the kernel: every elementwise
@@ -934,7 +1099,7 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
             args = jax.tree.map(
                 to_minor,
                 (iids, slots, st, inbox, tick_mask, campaign_mask,
-                 propose_n, isolate),
+                 propose_n, isolate, transfer_to, read_req),
             )
             sti, out, aux = jax.vmap(
                 per_instance, in_axes=-1, out_axes=-1
@@ -943,7 +1108,7 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
         else:
             sti, out, aux = jax.vmap(per_instance)(
                 iids, slots, st, inbox, tick_mask, campaign_mask,
-                propose_n, isolate,
+                propose_n, isolate, transfer_to, read_req,
             )
         if with_aux:
             return sti, out, aux
@@ -973,9 +1138,16 @@ def make_step_round(cfg: BatchedConfig, iids=None, slots=None,
     else:
         slots = jnp.asarray(slots, I32)
     inner = _step_round_jit(cfg, with_aux)
+    n = iids.shape[0]
+    zero_i = jnp.zeros((n,), I32)
+    zero_b = jnp.zeros((n,), bool)
 
-    def step(st, inbox, tick_mask, campaign_mask, propose_n, isolate):
+    def step(st, inbox, tick_mask, campaign_mask, propose_n, isolate,
+             transfer_to=None, read_req=None):
         return inner(st, inbox, tick_mask, campaign_mask, propose_n,
-                     isolate, iids, slots)
+                     isolate,
+                     zero_i if transfer_to is None else transfer_to,
+                     zero_b if read_req is None else read_req,
+                     iids, slots)
 
     return step

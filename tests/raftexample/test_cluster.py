@@ -54,7 +54,7 @@ def backend(request):
     return request.param
 
 
-def wait_leader(nodes, timeout=10.0):
+def wait_leader(nodes, timeout=30.0):  # first-compile of the device kernel can eat ~15s
     live = {i: n for i, n in nodes.items() if not n._stopped.is_set()}
     box = {}
 
