@@ -47,17 +47,18 @@ from .step import T_SNAP
 # record types, server/storage/wal/walpb/record.pb.go).
 RT_ENTRY = 1  # group:u32 index:u64 term:u64 len:u32 data
 RT_HARDSTATE = 2  # group:u32 term:u64 vote:u32 commit:u64
-RT_SNAPSHOT = 3  # group:u32 index:u64 term:u64 len:u32 app_data
+RT_SNAPSHOT = 3  # same layout as RT_ENTRY; data = app snapshot
 
 
-def _pack_entry(group: int, index: int, term: int, data: bytes) -> bytes:
-    return struct.pack("<IQQI", group, index, term, len(data)) + data
+def _pack_entry(group: int, index: int, term: int, data: bytes,
+                etype: int = 0) -> bytes:
+    return struct.pack("<IQQBI", group, index, term, etype, len(data)) + data
 
 
-def _unpack_entry(b: bytes) -> Tuple[int, int, int, bytes]:
-    g, i, t, ln = struct.unpack_from("<IQQI", b)
-    off = struct.calcsize("<IQQI")
-    return g, i, t, b[off:off + ln]
+def _unpack_entry(b: bytes) -> Tuple[int, int, int, bytes, int]:
+    g, i, t, et, ln = struct.unpack_from("<IQQBI", b)
+    off = struct.calcsize("<IQQBI")
+    return g, i, t, b[off:off + ln], et
 
 
 def _pack_hs(group: int, term: int, vote: int, commit: int) -> bytes:
@@ -69,7 +70,8 @@ def _unpack_hs(b: bytes) -> Tuple[int, int, int, int]:
 
 
 def _pack_snap(group: int, index: int, term: int, data: bytes) -> bytes:
-    return struct.pack("<IQQI", group, index, term, len(data)) + data
+    # Same layout as entries (etype byte unused for snapshots).
+    return _pack_entry(group, index, term, data)
 
 
 _unpack_snap = _unpack_entry
@@ -192,13 +194,13 @@ class MultiRaftMember:
                 rr = rows[g]
                 rr.term, rr.vote, rr.commit = term, vote, commit
             elif rtype == RT_ENTRY:
-                g, i, t, d = _unpack_entry(data)
+                g, i, t, d, et = _unpack_entry(data)
                 lst = ents[g]
                 while lst and lst[-1][0] >= i:
                     lst.pop()  # WAL truncate-and-append semantics
-                lst.append((i, t, d))
+                lst.append((i, t, d, et))
             elif rtype == RT_SNAPSHOT:
-                g, i, t, d = _unpack_snap(data)
+                g, i, t, d, _et = _unpack_snap(data)
                 snaps[g] = (i, t, d)
                 ents[g] = [e for e in ents[g] if e[0] > i]
         restore: Dict[int, RowRestore] = {}
@@ -236,14 +238,17 @@ class MultiRaftMember:
             # 1. persist (one fsync for every group)
             for row, term, vote, commit in rd.hardstates:
                 self.wal.append(RT_HARDSTATE, _pack_hs(row, term, vote, commit))
-            for row, i, t, d in rd.entries:
-                self.wal.append(RT_ENTRY, _pack_entry(row, i, t, d))
+            for row, i, t, d, et in rd.entries:
+                self.wal.append(RT_ENTRY, _pack_entry(row, i, t, d, et))
             if rd.must_sync:
                 self.wal.flush(sync=True)
             # 2. apply committed payloads
             for row, items in rd.committed:
-                for i, _t, d in items:
-                    if d:
+                for i, _t, d, et in items:
+                    # Conf-change entries are membership, not KV data
+                    # (this hosting demo runs fixed-membership groups;
+                    # the type tag keeps them out of the state machine).
+                    if d and et == 0:
                         self.kvs[row].apply(d)
                     self.applied_index[row] = i
             # 3a. build outbound batch (MsgSnap carries app state at the

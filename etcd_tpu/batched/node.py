@@ -18,8 +18,9 @@ Differences from the host Node, by design:
   after taking an app snapshot, which moves the device ring floor, and
   outbound MsgSnap messages carry that snapshot's data — keeping the
   floor and the app snapshot index equal by construction;
-* conf changes are not yet on the device path (joint-consensus mask
-  swaps land with the confchange work; see VERDICT.md item 5).
+* conf changes ride the log as typed entries (types live in the host
+  arena beside payloads); on apply, the host Changer computes the new
+  config and uploads voter/learner/joint masks to the device.
 """
 
 from __future__ import annotations
@@ -109,6 +110,33 @@ class BatchedNode:
         # confirmed index covers its request time (linearizability).
         self._read_unbound: List[bytes] = []
         self._read_bound: Dict[int, List[bytes]] = {}
+        # Host config mirror driving confchange mask computation
+        # (the reference's ProgressTracker config half; progress lives
+        # on-device).
+        from ..raft.confchange import Changer, restore as cc_restore
+        from ..raft.tracker import ProgressTracker
+
+        self._conf_tracker = ProgressTracker(max_inflight=256)
+        boot_cs = restore.conf_state if restore is not None and getattr(
+            restore, "conf_state", None) else ConfState(
+            voters=list(self.peers))
+        cc_restore(Changer(self._conf_tracker, 0), boot_cs)
+        cs0 = self._conf_tracker.conf_state()
+        if (sorted(cs0.voters) != list(self.peers) or cs0.learners
+                or cs0.voters_outgoing):
+            self.rn.set_membership(
+                0,
+                voters=[v - 1 for v in cs0.voters],
+                voters_out=[v - 1 for v in cs0.voters_outgoing],
+                learners=[v - 1 for v in cs0.learners],
+                joint=bool(cs0.voters_outgoing),
+            )
+
+    def _current_conf_state(self) -> ConfState:
+        """Membership as last applied (snapshot metadata must reflect
+        conf changes, not the boot peer list)."""
+        with self._lock:
+            return self._conf_tracker.conf_state()
 
     # -- Node interface --------------------------------------------------------
 
@@ -120,41 +148,95 @@ class BatchedNode:
         self.rn.campaign([0])
         self._work.set()
 
-    def propose(self, data: bytes, timeout: Optional[float] = None) -> None:
-        """Leader: queue for the next round. Follower: forward to the
-        known leader over the wire (host-side MsgProp analog). The host
-        Node blocks until the proposal is accepted into the state
-        machine, so poll for a known leader up to `timeout` before
-        dropping (ref: node.go:464-501 stepWithWaitOption)."""
+    def _propose_entry(self, data: bytes, etype: EntryType,
+                       timeout: Optional[float]) -> None:
+        """Shared propose path: leaders queue for the next round,
+        followers forward to the known leader over the wire, no-leader
+        polls up to `timeout` before dropping (ref: node.go:464-501
+        stepWithWaitOption)."""
         deadline = time.monotonic() + (timeout if timeout else 5.0)
         while True:
             if self.rn.is_leader(0):
-                self.rn.propose(0, data)
+                self.rn.propose(0, data, etype=int(etype))
                 self._work.set()
                 return
             lead = self.rn.lead(0)
             if lead != 0:
                 with self._lock:
-                    self._fwd.append(
-                        Message(
-                            type=MessageType.MsgProp, to=lead, from_=self.id,
-                            entries=[Entry(data=data)],
-                        )
-                    )
+                    self._fwd.append(Message(
+                        type=MessageType.MsgProp, to=lead, from_=self.id,
+                        entries=[Entry(data=data, type=etype)],
+                    ))
                 self._work.set()
                 return
             if self._stopped or time.monotonic() >= deadline:
                 raise ProposalDroppedError("no leader; proposal dropped")
             time.sleep(0.01)
 
+    def propose(self, data: bytes, timeout: Optional[float] = None) -> None:
+        self._propose_entry(data, EntryType.EntryNormal, timeout)
+
     def propose_conf_change(self, cc, timeout: Optional[float] = None) -> None:
-        raise NotImplementedError(
-            "conf changes on the batched backend land with the "
-            "joint-consensus mask-swap work"
-        )
+        """Propose a membership change through the log; when it commits
+        and the app calls apply_conf_change, the new masks upload to
+        the device (ref: node.go ProposeConfChange; SURVEY §2.1
+        'confchange: host-side control plane, emits new masks').
+
+        Targets must be within the provisioned replica capacity R —
+        the batched layout pre-provisions slots, add/remove toggles
+        masks (capacity is a compile-time shape, membership is not)."""
+        from ..raft.types import ConfChangeV2
+
+        etype = (EntryType.EntryConfChangeV2
+                 if isinstance(cc, ConfChangeV2)
+                 else EntryType.EntryConfChange)
+        self._propose_entry(cc.marshal(), etype, timeout)
 
     def apply_conf_change(self, cc) -> ConfState:
-        return ConfState(voters=list(self.peers))
+        """Apply a committed conf change: compute the new config with
+        the same Changer the host raft uses (joint semantics included)
+        and upload the masks to the device
+        (ref: raft.go:896-905 applyConfChange → confchange.Changer)."""
+        from ..raft.confchange import Changer
+
+        cc2 = cc.as_v2()
+        bad = [c.node_id for c in cc2.changes
+               if not 1 <= c.node_id <= self.cfg.num_replicas]
+        if bad:
+            raise ValueError(
+                f"conf-change targets {bad} outside provisioned replica "
+                f"capacity R={self.cfg.num_replicas}")
+        with self._lock:
+            tr = self._conf_tracker
+            changer = Changer(tracker=tr, last_index=int(self.rn.m_last[0]))
+            if cc2.leave_joint():
+                cfg, prs = changer.leave_joint()
+            else:
+                auto_leave, use_joint = cc2.enter_joint()
+                if use_joint:
+                    cfg, prs = changer.enter_joint(auto_leave, cc2.changes)
+                else:
+                    cfg, prs = changer.simple(cc2.changes)
+            tr.config, tr.progress = cfg, prs
+            cs = tr.conf_state()
+            auto_leave = bool(cs.voters_outgoing) and tr.config.auto_leave
+        self.rn.set_membership(
+            0,
+            voters=[v - 1 for v in cs.voters],
+            voters_out=[v - 1 for v in cs.voters_outgoing],
+            learners=[v - 1 for v in cs.learners],
+            joint=bool(cs.voters_outgoing),
+        )
+        if auto_leave and self.rn.is_leader(0):
+            # The leader auto-proposes the empty change that exits an
+            # implicit joint config (ref: raft.go advance() proposing
+            # the zero ConfChangeV2 when autoLeave is pending).
+            from ..raft.types import ConfChangeV2
+
+            self.rn.propose(0, ConfChangeV2().marshal(),
+                            etype=int(EntryType.EntryConfChangeV2))
+        self._work.set()
+        return cs
 
     def step(self, m: Message) -> None:
         if m.type == MessageType.MsgTransferLeader:
@@ -169,7 +251,9 @@ class BatchedNode:
             # more toward our view of the leader; drop without one.
             if self.rn.is_leader(0):
                 for e in m.entries:
-                    self.rn.propose(0, e.data)
+                    # Entry types survive forwarding (a follower's conf
+                    # change must commit as EntryConfChange).
+                    self.rn.propose(0, e.data, etype=int(e.type))
                 self._work.set()
                 return
             lead = self.rn.lead(0)
@@ -248,15 +332,14 @@ class BatchedNode:
         rd = self.rn.advance_round()
 
         entries = [
-            Entry(index=i, term=t, data=d, type=EntryType.EntryNormal)
-            for (_row, i, t, d) in rd.entries
+            Entry(index=i, term=t, data=d, type=EntryType(et))
+            for (_row, i, t, d, et) in rd.entries
         ]
         committed = []
         for _row, items in rd.committed:
             committed.extend(
-                Entry(index=i, term=t, data=d or b"",
-                      type=EntryType.EntryNormal)
-                for (i, t, d) in items
+                Entry(index=i, term=t, data=d or b"", type=EntryType(et))
+                for (i, t, d, et) in items
             )
 
         snapshot = Snapshot()
@@ -274,7 +357,7 @@ class BatchedNode:
                 snapshot = Snapshot(
                     metadata=SnapshotMetadata(
                         index=idx, term=term,
-                        conf_state=ConfState(voters=list(self.peers)),
+                        conf_state=self._current_conf_state(),
                     )
                 )
             self.rn.install_snapshot_state(0, idx)
@@ -347,7 +430,7 @@ class BatchedNode:
         return Snapshot(
             metadata=SnapshotMetadata(
                 index=index, term=term,
-                conf_state=confstate or ConfState(voters=list(self.peers)),
+                conf_state=confstate or self._current_conf_state(),
             ),
             data=data,
         )

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..raft.types import (
     Entry,
+    EntryType,
     Message,
     MessageType,
     Snapshot,
@@ -95,7 +96,10 @@ class RowRestore:
     snap_index: int = 0  # log floor
     snap_term: int = 0
     entries: List[Tuple[int, int, bytes]] = field(default_factory=list)
-    # (index, term, data) strictly ascending, > snap_index
+    # (index, term, data[, etype]) strictly ascending, > snap_index
+    # Membership at the snapshot point (None → full-voter bootstrap;
+    # committed conf entries in the tail re-apply through Ready).
+    conf_state: Optional[object] = None
 
 
 @dataclass
@@ -180,6 +184,11 @@ class BatchedRawNode:
         self.arena: List[Dict[int, Tuple[int, bytes]]] = [
             {} for _ in range(self.n)
         ]
+        # Sparse entry-type registry: index -> EntryType for the rare
+        # non-Normal entries (conf changes); absent == EntryNormal.
+        # The device only ever sees (term, index); types ride the host
+        # arena like payloads do.
+        self.etypes: List[Dict[int, int]] = [{} for _ in range(self.n)]
 
         # Monotone commit watermark guarding arena immutability (see
         # step(): inbound MsgApp must not overwrite committed payloads).
@@ -224,9 +233,12 @@ class BatchedRawNode:
             snap_i[row] = rr.snap_index
             snap_t[row] = rr.snap_term
             li = rr.snap_index
-            for idx, t, data in rr.entries:
+            for ent in rr.entries:
+                idx, t, data = ent[0], ent[1], ent[2]
                 ring[row, idx % w] = t
                 self.arena[row][idx] = (t, data)
+                if len(ent) > 3 and ent[3]:
+                    self.etypes[row][idx] = int(ent[3])
                 li = idx
             last[row] = li
             self.applied[row] = rr.applied
@@ -272,12 +284,35 @@ class BatchedRawNode:
         with self._lock:
             self._isolate[rows] = on
 
-    def propose(self, row: int, data: bytes) -> None:
+    def propose(self, row: int, data: bytes, etype: int = 0) -> None:
         """Queue a payload; it is appended (and assigned an index) in a
-        round where this row is leader. Callers that need follower
-        forwarding do it above this layer (see batched/node.py)."""
+        round where this row is leader. `etype` tags non-Normal entries
+        (conf changes) — the tag rides the host arena, never the
+        device. Callers that need follower forwarding do it above this
+        layer (see batched/node.py)."""
         with self._lock:
-            self._props[row].append(data)
+            self._props[row].append((data, int(etype)))
+
+    def set_membership(self, row: int, voters, voters_out=(),
+                       learners=(), joint: bool = False) -> None:
+        """Upload new membership masks for one row — the confchange
+        apply point (ref: confchange/confchange.go; the host computes
+        slot sets, the device sees only masks). Safe mid-Ready: masks
+        are read by the next round."""
+        r = self.cfg.num_replicas
+
+        def mask(slots) -> jnp.ndarray:
+            slots = list(slots)
+            m = jnp.zeros((r,), bool)
+            return m.at[jnp.asarray(slots, I32)].set(True) if slots else m
+
+        st = self.state
+        self.state = st._replace(
+            voter=st.voter.at[row].set(mask(voters)),
+            voter_out=st.voter_out.at[row].set(mask(voters_out)),
+            learner=st.learner.at[row].set(mask(learners)),
+            in_joint=st.in_joint.at[row].set(bool(joint)),
+        )
 
     def transfer_leader(self, row: int, target_slot: int) -> None:
         """Stage a leadership handoff request on a leader row
@@ -309,6 +344,7 @@ class BatchedRawNode:
         if t == T_APP:
             with self._lock:
                 ar = self.arena[row]
+                et = self.etypes[row]
                 for e in m.entries:
                     # Never clobber a committed entry's payload with a
                     # conflicting (necessarily stale) one — committed
@@ -316,6 +352,9 @@ class BatchedRawNode:
                     # (post-snapshot resends).
                     if e.index > self._commit_guard[row] or e.index not in ar:
                         ar[e.index] = (e.term, e.data)
+                        et.pop(e.index, None)
+                        if int(e.type):
+                            et[e.index] = int(e.type)
         if t == T_SNAP and m.index == 0:
             # Device ring-floor metadata normally rides in index/log_term
             # (the app snapshot in m.snapshot may sit at a HIGHER applied
@@ -340,6 +379,7 @@ class BatchedRawNode:
             ar = self.arena[row]
             for i in [i for i in ar if i <= index]:
                 del ar[i]
+                self.etypes[row].pop(i, None)
 
     def has_work(self) -> bool:
         with self._lock:
@@ -418,8 +458,12 @@ class BatchedRawNode:
                 n_app = int(last[row] - last_tick[row])
                 base = int(last_tick[row])
                 for j in range(n_app):
-                    data = q.popleft()
-                    self.arena[row][base + 1 + j] = (int(term[row]), data)
+                    data, et = q.popleft()
+                    idx = base + 1 + j
+                    self.arena[row][idx] = (int(term[row]), data)
+                    self.etypes[row].pop(idx, None)
+                    if et:
+                        self.etypes[row][idx] = et
 
             # -- entry records to persist: contiguous (fc-1, last] where
             # fc is the first ring-changed index this round (or stable+1).
@@ -451,8 +495,10 @@ class BatchedRawNode:
                 for i in range(lo, int(last[row]) + 1):
                     t = int(ring64[row, i % w])
                     ar = self.arena[row].get(i)
-                    data = ar[1] if ar is not None and ar[0] == t else b""
-                    entries.append((row, i, t, data))
+                    ok = ar is not None and ar[0] == t
+                    data = ar[1] if ok else b""
+                    et = self.etypes[row].get(i, 0) if ok else 0
+                    entries.append((row, i, t, data, et))
 
             # -- hardstate deltas
             hardstates = [
@@ -473,11 +519,10 @@ class BatchedRawNode:
                 for i in range(lo + 1, int(commit[row]) + 1):
                     t = int(ring64[row, i % w])
                     ar = self.arena[row].get(i)
-                    data = (
-                        ar[1] if ar is not None and ar[0] == t and ar[1]
-                        else None
-                    )
-                    items.append((i, t, data))
+                    ok = ar is not None and ar[0] == t
+                    data = ar[1] if ok and ar[1] else None
+                    et = self.etypes[row].get(i, 0) if ok else 0
+                    items.append((i, t, data, et))
                 if items:
                     committed.append((int(row), items))
 
@@ -547,6 +592,7 @@ class BatchedRawNode:
                 if len(ar) > 2 * self.cfg.window:
                     for i in [i for i in ar if i <= fl]:
                         del ar[i]
+                        self.etypes[row].pop(i, None)
             self._round = None
 
     # -- internals -------------------------------------------------------------
@@ -631,8 +677,11 @@ class BatchedRawNode:
                     idx = m.index + 1 + j
                     et = int(out.ent_terms[row, tgt, k, j])
                     ar = self.arena[row].get(idx)
-                    data = b"" if ar is None or ar[0] != et else ar[1]
-                    ents.append(Entry(index=idx, term=et, data=data))
+                    ok = ar is not None and ar[0] == et
+                    data = ar[1] if ok else b""
+                    ety = self.etypes[row].get(idx, 0) if ok else 0
+                    ents.append(Entry(index=idx, term=et, data=data,
+                                      type=EntryType(ety)))
                 m.entries = ents
             elif t == T_SNAP:
                 # metadata only; the hosting layer attaches app data

@@ -177,10 +177,14 @@ class ExampleRaftNode:
                 snap_index=base,
                 snap_term=snap.metadata.term,
                 entries=[
-                    (e.index, e.term, e.data)
+                    (e.index, e.term, e.data, int(e.type))
                     for e in ents
                     if e.index > base
                 ],
+                # Membership at the snapshot point; conf entries in the
+                # replayed tail re-apply through Ready on top of it.
+                conf_state=(snap.metadata.conf_state
+                            if snap.metadata.index > 0 else None),
             )
         else:
             self.wal = WAL.create(

@@ -330,6 +330,133 @@ class TestNodeContract:
         assert nodes[target].rn.is_leader(0)
 
 
+class TestConfChangeThroughLog:
+    """propose_conf_change → committed EntryConfChange → Changer →
+    device mask upload, through the Node contract (node.go
+    ProposeConfChange / raft.go applyConfChange)."""
+
+    def _cluster(self):
+        from etcd_tpu.batched.node import BatchedNode
+
+        return {i: BatchedNode(i, [1, 2, 3], election_tick=4)
+                for i in (1, 2, 3)}
+
+    def _pump_until(self, nodes, confstates, pred, rounds=60):
+        from etcd_tpu.raft.types import ConfChange, ConfChangeV2, EntryType
+
+        for _ in range(rounds):
+            for n in nodes.values():
+                n.tick()
+            for i, n in nodes.items():
+                rd = n.ready(timeout=0.05)
+                if rd is None:
+                    continue
+                for e in rd.committed_entries:
+                    if e.type == EntryType.EntryConfChange and e.data:
+                        confstates[i] = n.apply_conf_change(
+                            ConfChange.unmarshal(e.data))
+                    elif e.type == EntryType.EntryConfChangeV2:
+                        confstates[i] = n.apply_conf_change(
+                            ConfChangeV2.unmarshal(e.data))
+                for m in rd.messages:
+                    nodes[m.to].step(m)
+                n.advance()
+            if pred():
+                return True
+        return False
+
+    def test_remove_then_readd_voter(self):
+        from etcd_tpu.raft.types import ConfChange, ConfChangeType
+
+        nodes = self._cluster()
+        confstates = {}
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: any(n.rn.is_leader(0) for n in nodes.values()))
+        leader_id = next(i for i, n in nodes.items() if n.rn.is_leader(0))
+        victim = next(i for i in nodes
+                      if i != leader_id)
+
+        # Remove a follower: every member's masks drop it.
+        nodes[leader_id].propose_conf_change(ConfChange(
+            id=1, type=ConfChangeType.ConfChangeRemoveNode,
+            node_id=victim))
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: confstates.get(leader_id) is not None
+            and victim not in confstates[leader_id].voters)
+        lead_node = nodes[leader_id]
+        import numpy as np
+        assert not bool(np.asarray(
+            lead_node.rn.state.voter[0])[victim - 1])
+
+        # The 2-voter cluster still commits.
+        lead_node.propose(b"two-voter-write")
+        base = lead_node.rn.latest_commit(0)
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: lead_node.rn.latest_commit(0) > base)
+
+        # Re-add as learner, then promote to voter.
+        lead_node.propose_conf_change(ConfChange(
+            id=2, type=ConfChangeType.ConfChangeAddLearnerNode,
+            node_id=victim))
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: confstates.get(leader_id) is not None
+            and victim in confstates[leader_id].learners)
+        assert bool(np.asarray(
+            lead_node.rn.state.learner[0])[victim - 1])
+
+        lead_node.propose_conf_change(ConfChange(
+            id=3, type=ConfChangeType.ConfChangeAddNode, node_id=victim))
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: confstates.get(leader_id) is not None
+            and victim in confstates[leader_id].voters)
+        assert bool(np.asarray(
+            lead_node.rn.state.voter[0])[victim - 1])
+
+    def test_joint_confchange_v2(self):
+        """Explicit-joint V2 change passes through enter/leave joint
+        with the device masks tracking both halves."""
+        import numpy as np
+
+        from etcd_tpu.raft.types import (
+            ConfChangeSingle, ConfChangeTransition, ConfChangeType,
+            ConfChangeV2)
+
+        nodes = self._cluster()
+        confstates = {}
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: any(n.rn.is_leader(0) for n in nodes.values()))
+        leader_id = next(i for i, n in nodes.items() if n.rn.is_leader(0))
+        lead_node = nodes[leader_id]
+        victim = next(i for i in nodes if i != leader_id)
+
+        cc = ConfChangeV2(
+            transition=ConfChangeTransition.ConfChangeTransitionJointExplicit,
+            changes=[ConfChangeSingle(
+                ConfChangeType.ConfChangeRemoveNode, victim)],
+        )
+        lead_node.propose_conf_change(cc)
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: confstates.get(leader_id) is not None
+            and bool(confstates[leader_id].voters_outgoing))
+        assert bool(np.asarray(lead_node.rn.state.in_joint)[0])
+
+        # Leave joint.
+        lead_node.propose_conf_change(ConfChangeV2())
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: confstates.get(leader_id) is not None
+            and not confstates[leader_id].voters_outgoing
+            and victim not in confstates[leader_id].voters)
+        assert not bool(np.asarray(lead_node.rn.state.in_joint)[0])
+
+
 class TestReadIndex:
     def test_read_confirms_with_quorum(self):
         cfg, eng = make_engine(r=3)
