@@ -25,6 +25,7 @@ class Cluster:
             self.servers[nid] = self._spawn(nid)
 
     def _spawn(self, nid: int) -> EtcdServer:
+        kw = {"request_timeout": 10.0, **self.cfg_kw}
         return EtcdServer(
             ServerConfig(
                 member_id=nid,
@@ -32,8 +33,7 @@ class Cluster:
                 data_dir=self.data_dir,
                 network=self.net,
                 tick_interval=self.tick_interval,
-                request_timeout=10.0,
-                **self.cfg_kw,
+                **kw,
             )
         )
 
@@ -99,6 +99,18 @@ class Cluster:
     def drop(self, a: int, b: int, prob: float) -> None:
         self.net.drop(a, b, prob)
         self.net.drop(b, a, prob)
+
+    def delay_peer(self, nid: int, base_s: float,
+                   jitter_s: float = 0.0) -> None:
+        """Add latency to ALL of nid's links, both directions
+        (DELAY_PEER_PORT_TX_RX_{ONE_FOLLOWER,LEADER} cases)."""
+        for other in self.peers:
+            if other != nid:
+                self.net.delay(nid, other, base_s, jitter_s)
+                self.net.delay(other, nid, base_s, jitter_s)
+
+    def undelay_all(self) -> None:
+        self.net.undelay()
 
     def close(self) -> None:
         for nid, s in self.servers.items():

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import queue
 import random
+import time
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -26,6 +27,12 @@ class InProcNetwork:
         self._pumps: Dict[int, threading.Thread] = {}
         self._isolated: Set[int] = set()
         self._dropped: Dict[Tuple[int, int], float] = {}
+        # Directed-link latency injection: (from, to) -> (base_s, jitter_s)
+        # (ref: functional DELAY_PEER_PORT_TX_RX cases, rpcpb/rpc.proto).
+        self._delayed: Dict[Tuple[int, int], Tuple[float, float]] = {}
+        # Per-link delivery-time floor keeping delayed links FIFO
+        # (a TCP stream delays, it does not reorder).
+        self._delay_floor: Dict[Tuple[int, int], float] = {}
         self._rand = random.Random(seed)
         self._stopped = False
 
@@ -61,13 +68,33 @@ class InProcNetwork:
                 return
             if self._rand.random() < self._dropped.get((from_id, m.to), 0.0):
                 return
+            dly = self._delayed.get((from_id, m.to))
+            delay_s = 0.0
+            if dly:
+                now = time.monotonic()
+                at = now + dly[0] + self._rand.random() * dly[1]
+                # FIFO floor: a later message never overtakes an
+                # earlier one on the same link, jitter or not.
+                key = (from_id, m.to)
+                at = max(at, self._delay_floor.get(key, 0.0))
+                self._delay_floor[key] = at
+                delay_s = at - now
             q = self._queues.get(m.to)
         if q is None:
             return
-        try:
-            q.put_nowait(m)  # drop, never block (rafthttp semantics)
-        except queue.Full:
-            pass
+
+        def put() -> None:
+            try:
+                q.put_nowait(m)  # drop, never block (rafthttp semantics)
+            except queue.Full:
+                pass
+
+        if delay_s > 0:
+            t = threading.Timer(delay_s, put)
+            t.daemon = True
+            t.start()
+        else:
+            put()
 
     def _pump(self, node_id: int, q: "queue.Queue[Message]") -> None:
         while True:
@@ -92,12 +119,19 @@ class InProcNetwork:
             self._isolated.add(node_id)
 
     def heal(self, node_id: Optional[int] = None) -> None:
+        """Clear faults: all of them (no arg), or everything touching
+        one member — isolation, drops, and delays alike."""
         with self._lock:
             if node_id is None:
                 self._isolated.clear()
                 self._dropped.clear()
+                self._delayed.clear()
+                self._delay_floor.clear()
             else:
                 self._isolated.discard(node_id)
+                for d in (self._dropped, self._delayed, self._delay_floor):
+                    for k in [k for k in d if node_id in k]:
+                        del d[k]
 
     def drop(self, from_id: int, to_id: int, prob: float) -> None:
         with self._lock:
@@ -111,6 +145,29 @@ class InProcNetwork:
         with self._lock:
             self._dropped.pop((a, b), None)
             self._dropped.pop((b, a), None)
+
+    def delay(self, from_id: int, to_id: int, base_s: float,
+              jitter_s: float = 0.0) -> None:
+        """Add latency (with jitter) to a directed link — the
+        functional suite's delay-peer-traffic fault class."""
+        with self._lock:
+            self._delayed[(from_id, to_id)] = (base_s, jitter_s)
+
+    def undelay(self, from_id: Optional[int] = None,
+                to_id: Optional[int] = None) -> None:
+        """Clear delays: everything (no args), every link touching
+        from_id (one arg), or one directed link (both args)."""
+        with self._lock:
+            if from_id is None:
+                self._delayed.clear()
+                self._delay_floor.clear()
+            elif to_id is None:
+                for d in (self._delayed, self._delay_floor):
+                    for k in [k for k in d if from_id in k]:
+                        del d[k]
+            else:
+                self._delayed.pop((from_id, to_id), None)
+                self._delay_floor.pop((from_id, to_id), None)
 
     def stop(self) -> None:
         with self._lock:

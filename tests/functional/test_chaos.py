@@ -179,6 +179,153 @@ class TestFailpointCases:
         hash_check(cluster.alive())
 
 
+class TestDelayCases:
+    """DELAY_PEER_PORT_TX_RX cases (rpcpb/rpc.proto) — latency, not
+    loss: the cluster must keep committing, just slower."""
+
+    def test_delay_follower_traffic(self, cluster):
+        victim = cluster.followers()[0].id
+        run_case(
+            cluster,
+            inject=lambda: cluster.delay_peer(victim, 0.05, 0.05),
+            recover=cluster.undelay_all,
+        )
+
+    def test_delay_leader_traffic(self, cluster):
+        lead = cluster.wait_leader().id
+        run_case(
+            cluster,
+            inject=lambda: cluster.delay_peer(lead, 0.05, 0.05),
+            recover=cluster.undelay_all,
+        )
+
+
+class TestSnapshotCatchupCases:
+    """'until trigger snapshot' cases: a dead member misses enough
+    entries that the leader compacts past it; recovery must go through
+    the snapshot path (ref: tester case SIGTERM_ONE_FOLLOWER_UNTIL_
+    TRIGGER_SNAPSHOT)."""
+
+    @pytest.fixture()
+    def snap_cluster(self, tmp_path):
+        c = Cluster(str(tmp_path), n=3,
+                    snapshot_count=20, snapshot_catchup_entries=5)
+        c.wait_leader()
+        yield c
+        c.close()
+        failpoint.disable_all()
+
+    def test_kill_follower_until_trigger_snapshot(self, snap_cluster):
+        c = snap_cluster
+        lead = c.wait_leader()
+        victim = c.followers()[0].id
+        c.kill(victim)
+
+        # Push well past snapshot_count so the leader snapshots and
+        # compacts its raft log beyond the dead member's position.
+        for i in range(40):
+            lead.put(PutRequest(key=b"k%d" % i, value=b"v%d" % i))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if lead.raft_storage.first_index() > 10:
+                break
+            time.sleep(0.05)
+        assert lead.raft_storage.first_index() > 10, \
+            "leader never compacted its raft log"
+
+        s = c.restart(victim)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if s.applied_index() >= lead.applied_index():
+                break
+            time.sleep(0.05)
+        assert s.applied_index() >= lead.applied_index(), \
+            "snapshot catch-up never completed"
+        # Catch-up genuinely required the snapshot path: the member's
+        # restart position was below the leader's first log index.
+        hash_check(c.alive())
+        resp = s.range(RangeRequest(key=b"k0", serializable=True))
+        assert resp.kvs and resp.kvs[0].value == b"v0"
+
+    def test_blackhole_follower_until_trigger_snapshot(self, snap_cluster):
+        c = snap_cluster
+        lead = c.wait_leader()
+        victim = c.followers()[0].id
+        c.blackhole(victim)
+        for i in range(40):
+            lead.put(PutRequest(key=b"b%d" % i, value=b"w%d" % i))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if lead.raft_storage.first_index() > 10:
+                break
+            time.sleep(0.05)
+        assert lead.raft_storage.first_index() > 10, \
+            "leader never compacted its raft log"
+        c.unblackhole(victim)
+        s = c.servers[victim]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if s.applied_index() >= lead.applied_index():
+                break
+            time.sleep(0.05)
+        assert s.applied_index() >= lead.applied_index()
+        hash_check(c.alive())
+
+
+class TestFiveMemberCases:
+    """Larger quorum geometry (the functional suite runs 5-member
+    clusters; failure budget is 2)."""
+
+    @pytest.fixture()
+    def five(self, tmp_path):
+        # Short request timeout so the no-quorum case fails fast.
+        c = Cluster(str(tmp_path), n=5, request_timeout=1.5)
+        c.wait_leader()
+        yield c
+        c.close()
+        failpoint.disable_all()
+
+    def test_kill_two_keeps_quorum(self, five):
+        victims = [f.id for f in five.followers()[:2]]
+        run_case(
+            five,
+            inject=lambda: [five.kill(v) for v in victims],
+            recover=lambda: [five.restart(v) for v in victims],
+        )
+
+    def test_kill_three_loses_quorum_then_recovers(self, five):
+        lead = five.wait_leader()
+        victims = [f.id for f in five.followers()[:3]]
+        for v in victims:
+            five.kill(v)
+        # 2/5 alive: the write can never commit and the proposal
+        # wait must time out.
+        from etcd_tpu.server.server import TimeoutError_
+
+        with pytest.raises(TimeoutError_):
+            lead.put(PutRequest(key=b"noq", value=b"x"))
+        for v in victims:
+            five.restart(v)
+        lead = five.wait_leader()
+        lead.put(PutRequest(key=b"back", value=b"y"))
+        linearizable_check(lead, b"back", b"y")
+        hash_check(five.alive())
+
+    def test_delay_and_loss_soak_five_members(self, five):
+        """Combined latency + loss on two links under stress."""
+        a, b = [f.id for f in five.followers()[:2]]
+
+        def inject():
+            five.delay_peer(a, 0.03, 0.05)
+            five.drop(b, five.wait_leader().id, 0.3)
+
+        def recover():
+            five.undelay_all()
+            five.net.heal()
+
+        run_case(five, inject=inject, recover=recover, stress_seconds=1.0)
+
+
 class TestLeaseCase:
     def test_lease_expiry_after_leader_kill(self, cluster):
         ls = LeaseStresser(cluster, ttl=2)
