@@ -11,7 +11,7 @@ from __future__ import annotations
 import hashlib
 import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
 DEFAULT_NAME = "default"
@@ -74,6 +74,13 @@ class Config:
     # initial-cluster is given, the roster comes from this cluster.
     discovery_endpoints: str = ""  # "host:port,host:port"
     discovery_token: str = ""
+    # DNS SRV discovery (ref: --discovery-srv/--discovery-srv-name,
+    # client/pkg/srv): when set and no initial-cluster is given, the
+    # roster comes from _etcd-server._tcp.<domain> records.
+    discovery_srv: str = ""
+    discovery_srv_name: str = ""
+    # Test/deployment seam: callable(name) -> [(host, port)].
+    srv_resolver: Any = None
     # Raft timing (milliseconds, ref: config.go TickMs/ElectionMs).
     heartbeat_interval: int = 100
     election_timeout: int = 1000
@@ -99,6 +106,12 @@ class Config:
     # --experimental-corrupt-check-time).
     initial_corrupt_check: bool = False
     corrupt_check_time: float = 0.0  # seconds between periodic checks
+    # Legacy v2 API (ref: --enable-v2) and the JSON gateway listener
+    # (the reference serves grpc-gateway on the client listener; here
+    # it gets its own HTTP port — never the metrics listener).
+    enable_v2: bool = False
+    listen_v2_urls: str = ""  # "" -> client host, ephemeral port
+    listen_gateway_urls: str = ""  # "" -> gateway disabled
     # Ops.
     enable_pprof: bool = False
     log_level: str = "info"

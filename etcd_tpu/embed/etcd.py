@@ -34,6 +34,8 @@ class Etcd:
         self.transport: Optional[TCPTransport] = None
         self.rpc: Optional[V3RPCServer] = None
         self.http: Optional[EtcdHTTP] = None
+        self.v2http = None  # legacy /v2/keys listener (v2http.V2HTTP)
+        self.gateway = None  # JSON gateway listener (EtcdHTTP)
         self._closed = threading.Event()
 
     # Addresses, resolved after bind (port 0 supported for tests).
@@ -57,6 +59,10 @@ class Etcd:
         if self._closed.is_set():
             return
         self._closed.set()
+        if self.v2http is not None:
+            self.v2http.close()
+        if self.gateway is not None:
+            self.gateway.close()
         if self.http is not None:
             self.http.close()
         if self.rpc is not None:
@@ -80,6 +86,23 @@ def start_etcd(cfg: Config) -> Etcd:
         if not _verify(cfg.data_dir):
             raise RuntimeError(f"ETCD_VERIFY failed for {cfg.data_dir}")
     e = Etcd(cfg)
+
+    if cfg.discovery_srv and not cfg.initial_cluster:
+        # DNS SRV discovery (ref: etcdmain/etcd.go → srv.GetCluster):
+        # the record matching our advertised peer URL is us.
+        from ..client.srv import get_cluster
+
+        peer_tls = bool(cfg.peer_cert_file or cfg.peer_auto_tls)
+        service = "etcd-server-ssl" if peer_tls else "etcd-server"
+        mine = {u.strip() for u in
+                cfg.effective_advertise_peer_urls().split(",")}
+        parts = []
+        for entry in get_cluster(service, cfg.discovery_srv_name,
+                                 cfg.name, cfg.discovery_srv,
+                                 resolver=cfg.srv_resolver):
+            nm, _, url = entry.partition("=")
+            parts.append(f"{cfg.name}={url}" if url in mine else entry)
+        cfg.initial_cluster = ",".join(parts)
 
     if cfg.discovery_endpoints and cfg.discovery_token and not cfg.initial_cluster:
         # v3 discovery: register with the discovery cluster and wait
@@ -149,6 +172,22 @@ def start_etcd(cfg: Config) -> Etcd:
         client_bind = parse_urls(cfg.listen_client_urls)[0]
         e.rpc = V3RPCServer(server, bind=client_bind,
                             tls_info=cfg.client_tls_info())
+
+        if cfg.enable_v2:
+            # Legacy /v2/keys listener (ref: --enable-v2; the reference
+            # multiplexes it on the client listener via cmux).
+            from ..v2http import V2HTTP
+
+            v2_bind = (parse_urls(cfg.listen_v2_urls)[0]
+                       if cfg.listen_v2_urls else (client_bind[0], 0))
+            e.v2http = V2HTTP(server, bind=v2_bind)
+
+        if cfg.listen_gateway_urls:
+            # grpc-gateway JSON interop on its own listener — NEVER on
+            # the metrics listener (it carries writes).
+            gw_bind = parse_urls(cfg.listen_gateway_urls)[0]
+            e.gateway = EtcdHTTP(server=server, bind=gw_bind,
+                                 serve_gateway=True)
 
         if cfg.listen_metrics_urls:
             metrics_bind = parse_urls(cfg.listen_metrics_urls)[0]

@@ -35,8 +35,12 @@ class EtcdHTTP:
         server=None,
         bind: Tuple[str, int] = ("127.0.0.1", 0),
         registry: Optional[pmet.Registry] = None,
+        serve_gateway: bool = False,
     ) -> None:
+        """`serve_gateway` mounts the JSON write surface (/v3/...) on
+        this listener — keep it OFF for metrics/health listeners."""
         self.server = server
+        self.serve_gateway = serve_gateway
         self.registry = registry or pmet.DEFAULT
         outer = self
 
@@ -48,6 +52,9 @@ class EtcdHTTP:
 
             def do_GET(self):
                 outer._route(self)
+
+            def do_POST(self):
+                outer._gateway(self)
 
         self.httpd = ThreadingHTTPServer(bind, Handler)
         self.addr = self.httpd.server_address
@@ -85,6 +92,35 @@ class EtcdHTTP:
             self._checkz(h, u.path, q)
         else:
             self._reply(h, 404, b"404 page not found\n")
+
+    def _gateway(self, h: BaseHTTPRequestHandler) -> None:
+        """The grpc-gateway JSON interop surface: POST /v3/... with a
+        JSON body, bytes base64 (ref: embed/serve.go grpc-gateway mux;
+        gatewayjson.py carries the route table)."""
+        u = urlparse(h.path)
+        not_found = json.dumps({
+            "error": "Not Found", "code": 5, "message": "Not Found",
+        }).encode()
+        if (not self.serve_gateway or not u.path.startswith("/v3/")
+                or self.server is None):
+            self._reply(h, 404, not_found, "application/json")
+            return
+        from . import gatewayjson
+
+        try:
+            ln = int(h.headers.get("Content-Length") or 0)
+            body = json.loads(h.rfile.read(ln) or b"{}")
+            token = h.headers.get("Authorization") or None
+            out = gatewayjson.handle(self.server, u.path, body, token=token)
+            self._reply(h, 200, json.dumps(out).encode(),
+                        "application/json")
+        except KeyError:
+            self._reply(h, 404, not_found, "application/json")
+        except Exception as e:  # noqa: BLE001 — gateway error body
+            err = {"error": str(e), "code": 2,
+                   "message": str(e)}
+            self._reply(h, 400, json.dumps(err).encode(),
+                        "application/json")
 
     def _reply(
         self, h: BaseHTTPRequestHandler, code: int, body: bytes,

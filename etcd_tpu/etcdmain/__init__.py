@@ -56,6 +56,11 @@ def _add_member_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--peer-client-cert-auth", action="store_true")
     p.add_argument("--peer-auto-tls", action="store_true")
     p.add_argument("--discovery-endpoints", default="")
+    p.add_argument("--discovery-srv", default="")
+    p.add_argument("--enable-v2", action="store_true")
+    p.add_argument("--listen-v2-urls", default="")
+    p.add_argument("--listen-gateway-urls", default="")
+    p.add_argument("--discovery-srv-name", default="")
     p.add_argument("--discovery-token", default="")
     p.add_argument("--log-level", default=cfg.log_level)
     p.add_argument("--enable-pprof", action="store_true")
@@ -69,7 +74,8 @@ def _config_from_args(args: argparse.Namespace) -> Config:
     for f in cfg.__dataclass_fields__:
         if hasattr(args, f):
             setattr(cfg, f, getattr(args, f))
-    if not cfg.initial_cluster and not cfg.discovery_token:
+    if not cfg.initial_cluster and not cfg.discovery_token \
+            and not cfg.discovery_srv:
         cfg.initial_cluster = (
             f"{cfg.name}={cfg.effective_advertise_peer_urls()}"
         )

@@ -293,6 +293,11 @@ class EtcdServer:
         self.auth_store = AuthStore(self.be, token_provider=provider)
         self.alarms = AlarmStore(self.be)
         self.cluster = RaftCluster(self.cluster_id, self.be)
+        # Legacy v2 store: in-memory, rebuilt by replaying v2 ops from
+        # the WAL (ref: api/v2store; the deprecation-path subsystem).
+        from ..v2store.store import V2Store
+
+        self.v2store = V2Store()
 
     def _boot_raft(self) -> None:
         """Cold/warm start (ref: etcdserver/bootstrap.go:52-119)."""
@@ -313,6 +318,12 @@ class EtcdServer:
             if not is_empty_snap(snap):
                 self.raft_storage.apply_snapshot(snap)
                 self.confstate = snap.metadata.conf_state
+                try:
+                    v2blob = json.loads(snap.data.decode()).get("v2")
+                    if v2blob:
+                        self.v2store.recovery(v2blob)
+                except (ValueError, KeyError):
+                    pass  # pre-v2 snapshot format
             self.raft_storage.set_hard_state(hs)
             self.raft_storage.append(ents)
             # Raft replays ALL committed entries after the snapshot so
@@ -513,6 +524,8 @@ class EtcdServer:
             self._applied_index = snap.metadata.index
             self._term = max(self._term, snap.metadata.term)
             self.cindex.set_consistent_index(self._applied_index, self._term)
+            if "v2" in payload:
+                self.v2store.recovery(payload["v2"])
         finally:
             smet.snapshot_apply_in_progress.set(0)
 
@@ -554,9 +567,18 @@ class EtcdServer:
                     extend=self.cfg.election_tick * self.cfg.tick_interval
                 )
             return
+        req = InternalRaftRequest.unmarshal(e.data)
+        if req.op == "v2":
+            # v2 ops rebuild the in-memory v2 store on every replay —
+            # it is NOT backend-backed, so the consistent-index guard
+            # does not apply (ref: server.go applyV2Request; the
+            # reference replays the v2 store from WAL + snapshot).
+            result = self._apply_v2(req)
+            if should_apply and req.id != 0:
+                self.w.trigger(req.id, result)
+            return
         if not should_apply:
             return
-        req = InternalRaftRequest.unmarshal(e.data)
         result = self.applier.apply(req)
         if req.id != 0:
             self.w.trigger(req.id, result)
@@ -630,7 +652,13 @@ class EtcdServer:
         with open(tmp, "rb") as f:
             db_bytes = f.read()
         os.remove(tmp)
-        data = json.dumps({"db": db_bytes.hex()}).encode()
+        data = json.dumps({
+            "db": db_bytes.hex(),
+            # The v2 store rides the snapshot (the reference serializes
+            # it into .snap files, snapshot_merge.go) so pre-snapshot
+            # v2 state survives log compaction and restarts.
+            "v2": self.v2store.save(),
+        }).encode()
         snap = self.raft_storage.create_snapshot(
             self._applied_index, self.confstate, data
         )
@@ -900,6 +928,65 @@ class EtcdServer:
 
     def hash_kv(self, rev: int = 0):
         return self.kv.hash_kv(rev)
+
+    # -- v2 legacy surface (ref: etcdserver/apply_v2.go, v2store) --------------
+
+    def _apply_v2(self, r: InternalRaftRequest):
+        """Interpret a committed v2 op against the in-memory v2 store
+        (ref: apply_v2.go applierV2 Put/Post/Delete/QGet)."""
+        q = dict(r.req)
+        st = self.v2store
+        if "expire_at" in q:
+            # Remaining TTL at apply time; non-positive applies as an
+            # immediately-expirable sliver (the key was already dead).
+            q["ttl"] = max(q["expire_at"] - time.time(), 1e-6)
+        try:
+            method = q["method"]
+            path = q["path"]
+            if method == "set":
+                ev = st.set(path, dir_=q.get("dir", False),
+                            value=q.get("value", ""), ttl=q.get("ttl"))
+            elif method == "create":
+                ev = st.create(path, dir_=q.get("dir", False),
+                               value=q.get("value", ""), ttl=q.get("ttl"),
+                               unique=q.get("unique", False))
+            elif method == "update":
+                ev = st.update(path, value=q.get("value", ""),
+                               ttl=q.get("ttl"))
+            elif method == "cas":
+                ev = st.compare_and_swap(
+                    path, q.get("prev_value"), q.get("prev_index", 0),
+                    q.get("value", ""), ttl=q.get("ttl"))
+            elif method == "cad":
+                ev = st.compare_and_delete(
+                    path, q.get("prev_value"), q.get("prev_index", 0))
+            elif method == "delete":
+                ev = st.delete(path, recursive=q.get("recursive", False),
+                               dir_=q.get("dir", False))
+            else:
+                raise ValueError(f"unknown v2 method {method!r}")
+            return ApplyResult(resp=ev)
+        except Exception as e:  # noqa: BLE001 — V2Error travels to waiter
+            return ApplyResult(err=e)
+
+    def v2_write(self, method: str, path: str, **kwargs):
+        """Replicated v2 mutation: proposed through raft like every
+        other write (ref: v2http → etcdserver Do → raft)."""
+        req = {"method": method, "path": path}
+        req.update({k: v for k, v in kwargs.items() if v is not None})
+        # TTLs replicate as ABSOLUTE expiration set at proposal time
+        # (ref: v2http sets Expiration before etcdserver.Do), so WAL
+        # replay cannot resurrect long-expired keys.
+        if req.get("ttl") is not None:
+            req["expire_at"] = time.time() + float(req.pop("ttl"))
+        out = self.process_internal_raft_request("v2", req, None)
+        return out.resp
+
+    def v2_get(self, path: str, recursive: bool = False,
+               sorted_: bool = False):
+        """Local read of the v2 store (the reference's default
+        non-quorum GET path)."""
+        return self.v2store.get(path, recursive=recursive, sorted_=sorted_)
 
     def defrag(self) -> None:
         self.be.defrag()

@@ -1,0 +1,112 @@
+"""Read/write performance heatmap data generator
+(ref: tools/rw-heatmaps — sweeps value size × R/W ratio and emits CSV
+for the heatmap plot script).
+
+`python -m etcd_tpu.tools.rw_heatmaps --endpoints h:p [--out rw.csv]`
+runs a grid of (value_size, read_ratio) cells against a live cluster
+and writes one CSV row per cell:
+
+    value_size,conn_count,read_ratio,reads_per_sec,writes_per_sec
+
+The reference drives `benchmark mixed` over the same grid and plots
+with rw-heatmaps/plot_data.py; the CSV schema here matches what that
+plotting flow consumes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import random
+import sys
+import threading
+import time
+from typing import List, Tuple
+
+from ..client.client import Client
+from ..server import api as sapi
+
+
+def _parse_endpoints(s: str) -> List[Tuple[str, int]]:
+    out = []
+    for part in s.split(","):
+        part = part.strip()
+        if "://" in part:
+            part = part.split("://", 1)[1]
+        host, _, port = part.rpartition(":")
+        out.append((host, int(port)))
+    return out
+
+
+def run_cell(endpoints, value_size: int, read_ratio: float, clients: int,
+             duration: float) -> Tuple[float, float]:
+    """One grid cell: mixed load for `duration`s; returns (r/s, w/s)."""
+    counts = [[0, 0] for _ in range(clients)]  # [reads, writes]
+    stop = threading.Event()
+    value = b"x" * value_size
+
+    def worker(idx: int) -> None:
+        c = Client(endpoints)
+        rnd = random.Random(idx)
+        try:
+            while not stop.is_set():
+                key = b"heat/%d" % rnd.randrange(1000)
+                if rnd.random() < read_ratio:
+                    c.get(key, serializable=True)
+                    counts[idx][0] += 1
+                else:
+                    c.put(key, value)
+                    counts[idx][1] += 1
+        except Exception:  # noqa: BLE001 — cell ends on conn loss
+            pass
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    dt = time.perf_counter() - t0
+    reads = sum(c[0] for c in counts)
+    writes = sum(c[1] for c in counts)
+    return reads / dt, writes / dt
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="rw-heatmaps")
+    p.add_argument("--endpoints", default="127.0.0.1:2379")
+    p.add_argument("--out", default="rw_heatmap.csv")
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="seconds per grid cell")
+    p.add_argument("--value-sizes", default="64,256,1024,4096")
+    p.add_argument("--read-ratios", default="0.0,0.25,0.5,0.75,1.0")
+    args = p.parse_args(argv)
+
+    endpoints = _parse_endpoints(args.endpoints)
+    sizes = [int(x) for x in args.value_sizes.split(",")]
+    ratios = [float(x) for x in args.read_ratios.split(",")]
+
+    with open(args.out, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["value_size", "conn_count", "read_ratio",
+                    "reads_per_sec", "writes_per_sec"])
+        for size in sizes:
+            for ratio in ratios:
+                rps, wps = run_cell(endpoints, size, ratio,
+                                    args.clients, args.duration)
+                w.writerow([size, args.clients, ratio,
+                            f"{rps:.1f}", f"{wps:.1f}"])
+                print(f"size={size} ratio={ratio:.2f}: "
+                      f"{rps:.0f} r/s {wps:.0f} w/s", flush=True)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

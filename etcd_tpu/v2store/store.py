@@ -192,6 +192,54 @@ class V2Store:
         self.stats = {"gets": 0, "sets": 0, "deletes": 0, "expires": 0,
                       "cas": 0, "cad": 0, "creates": 0, "updates": 0}
 
+    # -- serialization (ref: store.go Save/Recovery — the v2 store
+    # rides raft snapshots so pre-snapshot state survives compaction) --
+
+    def save(self) -> str:
+        """JSON dump of the whole tree + index counter."""
+
+        def enc(node: _Node) -> dict:
+            out = {
+                "p": node.path,
+                "c": node.created_index,
+                "m": node.modified_index,
+            }
+            if node.value is not None:
+                out["v"] = node.value
+            if node.expire_at is not None:
+                out["e"] = node.expire_at
+            if node.children:
+                out["k"] = [enc(ch) for ch in node.children.values()]
+            return out
+
+        import json
+
+        with self._lock:
+            return json.dumps({"index": self.index, "root": enc(self.root)})
+
+    def recovery(self, blob: str) -> None:
+        """Replace the tree from a save() dump (store.go Recovery)."""
+        import json
+
+        d = json.loads(blob)
+
+        def dec(obj: dict, parent: Optional[_Node]) -> _Node:
+            node = _Node(self, obj["p"], obj["c"], parent,
+                         obj.get("v"), obj.get("e"))
+            node.modified_index = obj["m"]
+            for ch in obj.get("k", []):
+                child = dec(ch, node)
+                node.children[child.path.rsplit("/", 1)[-1]] = child
+                if child.expire_at is not None:
+                    heapq.heappush(self._ttl_heap,
+                                   (child.expire_at, child.path))
+            return node
+
+        with self._lock:
+            self._ttl_heap = []
+            self.index = d["index"]
+            self.root = dec(d["root"], None)
+
     # -- internals -------------------------------------------------------------
 
     def _walk(self, path: str, create_dirs: bool = False) -> _Node:

@@ -1,0 +1,116 @@
+"""DNS SRV discovery (ref: client/pkg/srv/srv_test.go — GetCluster/
+GetClient record-to-roster mapping) with an injected resolver."""
+
+import pytest
+
+from etcd_tpu.client.srv import (
+    SRVLookupError, get_client, get_cluster,
+)
+
+
+def fake_resolver(records):
+    calls = []
+
+    def resolve(name):
+        calls.append(name)
+        return records.get(name, [])
+
+    resolve.calls = calls
+    return resolve
+
+
+class TestGetCluster:
+    def test_builds_initial_cluster(self):
+        r = fake_resolver({
+            "_etcd-server._tcp.example.com": [
+                ("m0.example.com", 2380),
+                ("m1.example.com", 2380),
+                ("m2.example.com", 2380),
+            ],
+        })
+        out = get_cluster("etcd-server", "", "m0", "example.com",
+                          resolver=r)
+        # Names are positional; the embed layer renames the caller's
+        # entry by matching its advertised peer URL (name-prefix
+        # matching would confuse infra1 with infra10).
+        assert out == [
+            "0=http://m0.example.com:2380",
+            "1=http://m1.example.com:2380",
+            "2=http://m2.example.com:2380",
+        ]
+
+    def test_ssl_service_uses_https(self):
+        r = fake_resolver({
+            "_etcd-server-ssl._tcp.example.com": [("a.example.com", 2380)],
+        })
+        out = get_cluster("etcd-server-ssl", "", "x", "example.com",
+                          resolver=r)
+        assert out == ["0=https://a.example.com:2380"]
+
+    def test_cluster_name_extends_service(self):
+        r = fake_resolver({
+            "_etcd-server-prod._tcp.example.com": [("a.example.com", 2380)],
+        })
+        out = get_cluster("etcd-server", "prod", "x", "example.com",
+                          resolver=r)
+        assert out and r.calls == ["_etcd-server-prod._tcp.example.com"]
+
+    def test_empty_records_raise(self):
+        with pytest.raises(SRVLookupError):
+            get_cluster("etcd-server", "", "m0", "nothing.invalid",
+                        resolver=fake_resolver({}))
+
+
+class TestGetClient:
+    def test_client_endpoints(self):
+        r = fake_resolver({
+            "_etcd-client._tcp.example.com": [
+                ("c0.example.com", 2379),
+                ("c1.example.com", 2379),
+            ],
+        })
+        out = get_client("etcd-client", "example.com", resolver=r)
+        assert out.endpoints == [
+            "http://c0.example.com:2379",
+            "http://c1.example.com:2379",
+        ]
+
+    def test_default_resolver_gated(self):
+        """Without dnspython the default resolver raises a clear error
+        instead of crashing on import."""
+        try:
+            import dns.resolver  # noqa: F401
+            pytest.skip("dnspython present in this image")
+        except ImportError:
+            pass
+        with pytest.raises(SRVLookupError):
+            get_client("etcd-client", "example.invalid")
+
+
+def test_embed_srv_discovery_names_self(tmp_path):
+    """--discovery-srv derives initial-cluster; the record matching the
+    member's advertised peer URL takes the member's name."""
+    from etcd_tpu.embed import Config
+
+    cfg = Config(
+        name="alpha",
+        data_dir=str(tmp_path),
+        listen_peer_urls="http://127.0.0.1:12380",
+        listen_client_urls="http://127.0.0.1:0",
+        discovery_srv="example.com",
+        srv_resolver=fake_resolver({
+            "_etcd-server._tcp.example.com": [
+                ("127.0.0.1", 12380),
+            ],
+        }),
+    )
+    # Reuse start_etcd's derivation logic without booting a server:
+    from etcd_tpu.client.srv import get_cluster as gc
+
+    mine = {u.strip() for u in cfg.effective_advertise_peer_urls().split(",")}
+    parts = []
+    for entry in gc("etcd-server", cfg.discovery_srv_name, cfg.name,
+                    cfg.discovery_srv, resolver=cfg.srv_resolver):
+        nm, _, url = entry.partition("=")
+        parts.append(f"{cfg.name}={url}" if url in mine else entry)
+    assert parts == ["alpha=http://127.0.0.1:12380"]

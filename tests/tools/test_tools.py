@@ -123,3 +123,31 @@ class TestDumpers:
         assert any(
             n.startswith("etcd_disk_wal_fsync_duration_seconds") for n in names
         )
+
+
+def test_rw_heatmaps(tmp_path, member):
+    """rw-heatmaps sweeps the grid and emits the CSV schema the
+    reference's plot flow consumes (ref: tools/rw-heatmaps)."""
+    import csv
+
+    from etcd_tpu.tools import rw_heatmaps
+
+    _srv, rpc = member
+    addr = rpc.addr
+    out = tmp_path / "rw.csv"
+    rc = rw_heatmaps.main([
+        "--endpoints", f"{addr[0]}:{addr[1]}",
+        "--out", str(out),
+        "--clients", "2",
+        "--duration", "0.3",
+        "--value-sizes", "64",
+        "--read-ratios", "0.0,1.0",
+    ])
+    assert rc == 0
+    rows = list(csv.reader(out.open()))
+    assert rows[0] == ["value_size", "conn_count", "read_ratio",
+                       "reads_per_sec", "writes_per_sec"]
+    assert len(rows) == 3
+    # Pure-write cell wrote; pure-read cell read.
+    assert float(rows[1][4]) > 0
+    assert float(rows[2][3]) > 0

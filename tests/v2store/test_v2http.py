@@ -1,0 +1,260 @@
+"""v2 HTTP API + client/v2 over a replicated cluster
+(ref: tests/integration/v2store tests + client/v2 — the legacy REST
+surface, with writes riding raft)."""
+
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.client.v2 import V2Client, V2ClientError
+from etcd_tpu.v2http import V2HTTP
+from tests.framework.integration import IntegrationCluster
+
+
+@pytest.fixture
+def v2(tmp_path):
+    c = IntegrationCluster(str(tmp_path), n=3)
+    c.wait_leader()
+    https = {nid: V2HTTP(m.server) for nid, m in c.members.items()}
+    clients = {nid: V2Client([h.addr]) for nid, h in https.items()}
+    yield c, https, clients
+    for h in https.values():
+        h.close()
+    c.close()
+
+
+def _leader_client(c, clients):
+    lead = c.wait_leader()
+    return clients[lead.server.id]
+
+
+class TestKeysAPI:
+    def test_set_get_roundtrip(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        resp = cl.set("/foo", "bar")
+        assert resp.action == "set"
+        assert resp.node.value == "bar"
+        got = cl.get("/foo")
+        assert got.node.value == "bar"
+        assert got.node.modified_index == resp.node.modified_index
+
+    def test_writes_replicate_to_all_members(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        cl.set("/rep", "everywhere")
+        deadline = time.monotonic() + 10
+        servers = [m.server for m in c.members.values()]
+        while time.monotonic() < deadline:
+            try:
+                if all(s.v2_get("/rep").node.value == "everywhere"
+                       for s in servers):
+                    break
+            except Exception:  # noqa: BLE001 — not applied yet
+                pass
+            time.sleep(0.05)
+        for s in servers:
+            assert s.v2_get("/rep").node.value == "everywhere"
+
+    def test_create_fails_if_exists(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        cl.create("/once", "a")
+        with pytest.raises(V2ClientError) as ei:
+            cl.create("/once", "b")
+        assert ei.value.code == 105  # EcodeNodeExist
+
+    def test_update_requires_existing(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        with pytest.raises(V2ClientError) as ei:
+            cl.update("/ghost", "x")
+        assert ei.value.code == 100  # EcodeKeyNotFound
+
+    def test_compare_and_swap(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        cl.set("/cas", "v1")
+        resp = cl.set("/cas", "v2", prev_value="v1")
+        assert resp.action == "compareAndSwap"
+        with pytest.raises(V2ClientError) as ei:
+            cl.set("/cas", "v3", prev_value="wrong")
+        assert ei.value.code == 101  # EcodeTestFailed
+        assert cl.get("/cas").node.value == "v2"
+
+    def test_compare_and_delete(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        cl.set("/cad", "gone")
+        with pytest.raises(V2ClientError):
+            cl.delete("/cad", prev_value="nope")
+        cl.delete("/cad", prev_value="gone")
+        with pytest.raises(V2ClientError) as ei:
+            cl.get("/cad")
+        assert ei.value.code == 100
+
+    def test_directories_and_recursive_get(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        cl.set("/dir/a", "1")
+        cl.set("/dir/b", "2")
+        got = cl.get("/dir", recursive=True, sorted_=True)
+        assert got.node.dir
+        assert [n.key for n in got.node.nodes] == ["/dir/a", "/dir/b"]
+        with pytest.raises(V2ClientError) as ei:
+            cl.delete("/dir", dir_=True)  # not empty
+        assert ei.value.code == 108
+        cl.delete("/dir", recursive=True)
+
+    def test_create_in_order(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        r1 = cl.create_in_order("/queue", "job1")
+        r2 = cl.create_in_order("/queue", "job2")
+        assert r1.node.created_index < r2.node.created_index
+        got = cl.get("/queue", recursive=True, sorted_=True)
+        assert [n.value for n in got.node.nodes] == ["job1", "job2"]
+
+    def test_watch_long_poll(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        box = {}
+
+        def waiter():
+            box["ev"] = cl.watch("/watched", timeout=10.0)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        cl.set("/watched", "ping")
+        t.join(timeout=10)
+        assert box.get("ev") is not None
+        assert box["ev"].action == "set"
+        assert box["ev"].node.value == "ping"
+
+    def test_watch_with_wait_index_replays_history(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        r = cl.set("/hist", "old")
+        cl.set("/hist", "new")
+        ev = cl.watch("/hist", after_index=r.node.modified_index,
+                      timeout=5.0)
+        assert ev is not None and ev.node.value == "new"
+
+    def test_ttl_expiry(self, v2):
+        c, https, clients = v2
+        cl = _leader_client(c, clients)
+        cl.set("/fleeting", "x", ttl=1)
+        assert cl.get("/fleeting").node.value == "x"
+        time.sleep(1.3)
+        with pytest.raises(V2ClientError) as ei:
+            cl.get("/fleeting")
+        assert ei.value.code == 100
+
+
+class TestV2Recovery:
+    def test_v2_state_rebuilt_from_wal_replay(self, tmp_path):
+        """The v2 store is memory-only: a restarted member replays its
+        WAL and reconstructs it (ref: the reference rebuilds v2store
+        from snapshot + WAL)."""
+        c = IntegrationCluster(str(tmp_path), n=3)
+        try:
+            lead = c.wait_leader()
+            lead.server.v2_write("set", "/durable", value="v2data")
+            victim = next(nid for nid, m in c.members.items()
+                          if m.server is not None
+                          and m.server.id != lead.server.id)
+            # Wait for the victim to apply, then bounce it.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    if (c.members[victim].server.v2_get("/durable")
+                            .node.value == "v2data"):
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.05)
+            c.members[victim].terminate()
+            c.members[victim].restart()
+            s = c.members[victim].server
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    if s.v2_get("/durable").node.value == "v2data":
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.05)
+            assert s.v2_get("/durable").node.value == "v2data"
+        finally:
+            c.close()
+
+
+class TestV2Snapshot:
+    def test_v2_state_survives_snapshot_compaction(self, tmp_path):
+        """Pre-snapshot v2 data must ride the raft snapshot: after the
+        leader compacts its log, a restarted member recovers v2 keys
+        from the snapshot, not the (gone) WAL tail."""
+        c = IntegrationCluster(str(tmp_path), n=3,
+                               snapshot_count=10,
+                               snapshot_catchup_entries=3)
+        try:
+            lead = c.wait_leader().server
+            lead.v2_write("set", "/pre-snap", value="keepme")
+            # Drive past snapshot_count so every member snapshots.
+            from etcd_tpu.server.api import PutRequest
+
+            for i in range(25):
+                lead.put(PutRequest(key=b"pad%d" % i, value=b"x"))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if lead.raft_storage.first_index() > 5:
+                    break
+                time.sleep(0.05)
+            assert lead.raft_storage.first_index() > 5
+
+            victim = next(nid for nid, m in c.members.items()
+                          if m.server is not None
+                          and m.server.id != lead.id)
+            c.members[victim].terminate()
+            c.members[victim].restart()
+            s = c.members[victim].server
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                try:
+                    if s.v2_get("/pre-snap").node.value == "keepme":
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.05)
+            assert s.v2_get("/pre-snap").node.value == "keepme"
+        finally:
+            c.close()
+
+    def test_replicated_ttl_is_absolute(self, tmp_path):
+        """TTL expiration replicates as an absolute timestamp: a
+        restarted member replaying the WAL does not resurrect a key
+        that expired before the restart."""
+        c = IntegrationCluster(str(tmp_path), n=1)
+        try:
+            lead = c.wait_leader().server
+            lead.v2_write("set", "/short", value="x", ttl=1)
+            time.sleep(1.2)
+            nid = lead.id
+            member = next(m for m in c.members.values()
+                          if m.server is not None and m.server.id == nid)
+            member.terminate()
+            member.restart()
+            s = member.server
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if s.applied_index() > 0 and s.is_leader():
+                    break
+                time.sleep(0.05)
+            from etcd_tpu.v2store.store import V2Error
+
+            with pytest.raises(V2Error):
+                s.v2_get("/short")
+        finally:
+            c.close()
