@@ -126,3 +126,45 @@ class TestGrpcProxy:
             c.close()
         finally:
             proxy.stop()
+
+
+class TestHTTPProxy:
+    """v2 httpproxy: /v2/keys forwarded with endpoint failover
+    (ref: server/proxy/httpproxy)."""
+
+    def test_forward_and_failover(self, tmp_path):
+        import time
+
+        from etcd_tpu.client.v2 import V2Client
+        from etcd_tpu.proxy.httpproxy import HTTPProxy
+        from etcd_tpu.v2http import V2HTTP
+        from tests.framework.integration import IntegrationCluster
+
+        c = IntegrationCluster(str(tmp_path), n=3)
+        https = {}
+        proxy = None
+        try:
+            c.wait_leader()
+            https = {nid: V2HTTP(m.server) for nid, m in c.members.items()}
+            # Proxy fronts a DEAD endpoint first: connect-phase
+            # failover must skip it.
+            dead = ("127.0.0.1", 1)
+            proxy = HTTPProxy([dead] + [h.addr for h in https.values()])
+            cl = V2Client([proxy.addr], timeout=15.0)
+            resp = None
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    resp = cl.set("/proxied", "yes")
+                    break
+                except Exception:
+                    time.sleep(0.2)
+            assert resp is not None and resp.node.value == "yes"
+            got = cl.get("/proxied")
+            assert got.node.value == "yes"
+        finally:
+            if proxy is not None:
+                proxy.stop()
+            for h in https.values():
+                h.close()
+            c.close()
