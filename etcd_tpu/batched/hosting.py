@@ -42,6 +42,9 @@ from .rawnode import BatchedRawNode, BatchedReady, RowRestore
 from .state import BatchedConfig, LEADER
 from .step import T_SNAP
 
+
+from ..pkg.errors import NotLeaderError  # noqa: E402 — shared error type
+
 # WAL record types (the native walog carries opaque frames; these tags
 # make one log serve every group — ref: walpb's entry/state/snapshot
 # record types, server/storage/wal/walpb/record.pb.go).
@@ -151,6 +154,14 @@ class MultiRaftMember:
         self._send = send_fn  # set by the router/transport
         self._lock = threading.Lock()
         self.tick_interval = tick_interval
+        # ReadIndex bookkeeping for linearizable readers: the latest
+        # OPENED batch seq per group (readers bind to a batch opened
+        # at-or-after their request — an earlier batch's index may
+        # predate a write the reader has already observed) and the
+        # latest CONFIRMED (seq, index).
+        self._read_opened: Dict[int, int] = {}
+        self._read_results: Dict[int, Tuple[int, int]] = {}
+        self._read_cv = threading.Condition()
 
         restore = self._replay()
         groups = np.arange(num_groups, dtype=np.int32)
@@ -273,6 +284,15 @@ class MultiRaftMember:
                         data=self.kvs[row].snapshot(),
                     )
                 out.append((row, m))
+        # 2b. surface ReadIndex progress to waiting readers (after
+        #     apply: applied_index moved under the same round).
+        if rd.read_opened or rd.read_states or rd.committed:
+            with self._read_cv:
+                for row, seq in rd.read_opened:
+                    self._read_opened[row] = seq
+                for row, seq, idx in rd.read_states:
+                    self._read_results[row] = (seq, idx)
+                self._read_cv.notify_all()
         # 3b. send OUTSIDE the lock: delivery takes the receiver's lock,
         #     and two members sending to each other must not deadlock.
         if out and self._send is not None:
@@ -330,6 +350,48 @@ class MultiRaftMember:
 
     def get(self, group: int, key: bytes) -> Optional[bytes]:
         """Serializable read from local applied state."""
+        return self.kvs[group].data.get(key)
+
+    def linearizable_get(self, group: int, key: bytes,
+                         timeout: float = 5.0) -> Optional[bytes]:
+        """Linearizable read: open a device ReadIndex batch, wait for
+        its heartbeat-ack quorum, wait until the local apply watermark
+        covers the confirmed index, then read (ref: v3_server.go
+        linearizableReadLoop over Ready.ReadStates — here the batch
+        runs in the device kernel). Raises on a non-leader member so
+        callers redirect like clients following leader hints."""
+        if not self.rn.is_leader(group):
+            raise NotLeaderError(f"group {group}: not leader here")
+        # Any batch already opened captured its commit index BEFORE
+        # this request; the serving batch must open at-or-after it
+        # (the device latches requests arriving mid-batch, so waiting
+        # for confirmed seq > the pre-request opened seq is exact).
+        with self._read_cv:
+            base_open = self._read_opened.get(group, 0)
+        self.rn.read_index(group)
+        deadline = time.monotonic() + timeout
+
+        def confirmed():
+            got = self._read_results.get(group)
+            return got if got is not None and got[0] > base_open else None
+
+        with self._read_cv:
+            while True:
+                got = confirmed()
+                if got is not None:
+                    break
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    raise TimeoutError(
+                        f"group {group}: ReadIndex quorum not confirmed")
+                self._read_cv.wait(rem)
+            idx = got[1]
+            while self.applied_index[group] < idx:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    raise TimeoutError(
+                        f"group {group}: apply lagging read index {idx}")
+                self._read_cv.wait(rem)
         return self.kvs[group].data.get(key)
 
     def stop(self) -> None:

@@ -1,0 +1,7 @@
+"""Shared error types spanning layers (ref: api/v3rpc/rpctypes/error.go
+— one canonical table; the client failover set matches these by class
+name, so every layer must raise the same classes)."""
+
+
+class NotLeaderError(Exception):
+    """ref: rpctypes.ErrNotLeader — retry against the leader."""

@@ -107,8 +107,8 @@ class TimeoutError_(Exception):
     """ref: etcdserver.ErrTimeout."""
 
 
-class NotLeaderError(Exception):
-    """ref: rpctypes.ErrNotLeader (lease renew on follower)."""
+# Shared across layers (client failover matches by class name).
+from ..pkg.errors import NotLeaderError  # noqa: E402
 
 
 class TooManyRequestsError(Exception):
