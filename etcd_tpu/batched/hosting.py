@@ -366,14 +366,26 @@ class MultiRaftMember:
         # this request; the serving batch must open at-or-after it
         # (the device latches requests arriving mid-batch, so waiting
         # for confirmed seq > the pre-request opened seq is exact).
+        # The seq guard alone is not enough: a batch can open in the
+        # same device round that commits a write the caller already
+        # observed applied (solo groups confirm instantly), so the
+        # confirmed index must also cover the apply watermark at
+        # request time — every write this caller could have observed
+        # locally is at-or-below it.
         with self._read_cv:
             base_open = self._read_opened.get(group, 0)
+        base_applied = int(self.applied_index[group])
         self.rn.read_index(group)
         deadline = time.monotonic() + timeout
 
         def confirmed():
             got = self._read_results.get(group)
-            return got if got is not None and got[0] > base_open else None
+            ok = (
+                got is not None
+                and got[0] > base_open
+                and got[1] >= base_applied
+            )
+            return got if ok else None
 
         with self._read_cv:
             while True:
@@ -462,11 +474,15 @@ class MultiRaftCluster:
         for m in self.members.values():
             m.start()
 
-    def wait_leaders(self, timeout: float = 30.0) -> np.ndarray:
+    def wait_leaders(self, timeout: float = 60.0) -> np.ndarray:
         """Block until every group has an elected leader; returns the
-        per-group leader member id."""
+        per-group leader member id. Under heavy host load device rounds
+        can lag the tick clock, so leaderless groups are periodically
+        nudged with an explicit campaign (the hosting analog of etcd
+        clients retrying against a leaderless cluster)."""
         deadline = time.monotonic() + timeout
         g = next(iter(self.members.values())).g
+        next_nudge = time.monotonic() + 5.0
         while time.monotonic() < deadline:
             leads = np.zeros(g, np.int64)
             for m in self.members.values():
@@ -474,6 +490,11 @@ class MultiRaftCluster:
                 leads[mask] = m.id
             if (leads > 0).all():
                 return leads
+            if time.monotonic() >= next_nudge:
+                stuck = np.nonzero(leads == 0)[0]
+                first = next(iter(self.members.values()))
+                first.campaign(stuck)
+                next_nudge = time.monotonic() + 5.0
             time.sleep(0.05)
         raise TimeoutError("groups without leader")
 

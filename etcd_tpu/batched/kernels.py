@@ -37,8 +37,11 @@ def quorum_committed(match: jnp.ndarray, voter: jnp.ndarray) -> jnp.ndarray:
     masked = jnp.where(voter, match, 0)
     srt = jnp.sort(masked)  # ascending
     pos = jnp.clip(r - n // 2 - 1, 0, r - 1)
+    # One-hot pick instead of srt[pos]: traced-index gathers serialize
+    # on TPU; a compare+reduce over R stays on the VPU.
+    pick = jnp.sum(jnp.where(jnp.arange(r, dtype=I32) == pos, srt, 0), -1)
     # Empty config commits "everything" (joint-quorum convention).
-    return jnp.where(n == 0, MAX_I32, srt[pos])
+    return jnp.where(n == 0, MAX_I32, pick)
 
 
 def vote_result(votes: jnp.ndarray, voter: jnp.ndarray) -> jnp.ndarray:
@@ -94,10 +97,16 @@ def term_at(
     i: jnp.ndarray,
 ) -> jnp.ndarray:
     """Term of entry i; 0 outside [snap_index, last] (the reference's
-    "zero term on compacted/unavailable" behavior)."""
+    "zero term on compacted/unavailable" behavior).
+
+    `i` may be a scalar or an [..., K] batch of indexes; the ring read
+    is a one-hot compare+reduce over W (TPU-friendly: no gathers)."""
     w = log_term.shape[-1]
     in_ring = (i > snap_index) & (i <= last)
-    ring_val = log_term[jnp.clip(i, 0, None) % w]
+    p = jnp.arange(w, dtype=I32)
+    im = jnp.mod(jnp.clip(i, 0, None), w)
+    hit = jnp.expand_dims(im, -1) == p  # [..., W]
+    ring_val = jnp.sum(jnp.where(hit, log_term, 0), axis=-1)
     return jnp.where(
         i == snap_index, snap_term, jnp.where(in_ring, ring_val, 0)
     )
@@ -120,11 +129,13 @@ def find_conflict_by_term(
     """
     w = log_term.shape[-1]
     hi = jnp.minimum(index, last)
-    j = jnp.arange(w, dtype=I32)
-    idx = snap_index + 1 + j
+    # Iterate ring POSITIONS instead of indexes: ring slot p holds the
+    # unique index i_p in (snap_index, snap_index+W] with i_p % W == p,
+    # so the rotation-gather becomes a pure compare+reduce.
+    p = jnp.arange(w, dtype=I32)
+    idx = snap_index + 1 + jnp.mod(p - snap_index - 1, w)
     valid = idx <= hi
-    terms = log_term[idx % w]
-    cnt = jnp.sum((valid & (terms <= term)).astype(I32))
+    cnt = jnp.sum((valid & (log_term <= term)).astype(I32))
     # When nothing in the window matches, the reference's backward walk
     # stops at the dummy index (term = snap_term) or, if even that term
     # is too large, one below it (term() reports 0 below the dummy —
@@ -139,10 +150,27 @@ def ring_write(
 ) -> jnp.ndarray:
     """Write `count` terms at log positions start_index..start_index+count-1
     into the [W] ring."""
+    j = jnp.arange(terms.shape[-1], dtype=I32)
+    return ring_write_masked(log_term, start_index, terms, j < count)
+
+
+def ring_write_masked(
+    log_term: jnp.ndarray, start_index: jnp.ndarray, terms: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Write terms[j] at log position start_index+j for each masked j.
+
+    Scatter-free: a [W, K] outer compare selects which ring slot each
+    masked entry lands in (positions are distinct since K <= W and the
+    indexes are consecutive), then a reduce over K folds them in."""
     w = log_term.shape[-1]
     k = terms.shape[-1]
-    j = jnp.arange(k, dtype=I32)
-    pos = (start_index + j) % w
-    mask = j < count
-    cur = log_term[pos]
-    return log_term.at[pos].set(jnp.where(mask, terms, cur))
+    # K > W would alias ring positions and SUM colliding terms; shapes
+    # are static, so this check costs nothing at runtime.
+    assert k <= w, f"ring write batch {k} exceeds window {w}"
+    p = jnp.arange(w, dtype=I32)
+    jj = jnp.arange(k, dtype=I32)
+    pos_j = jnp.mod(start_index + jj, w)  # [K]
+    hit = (p[:, None] == pos_j[None, :]) & mask[None, :]  # [W, K]
+    val = jnp.sum(jnp.where(hit, terms[None, :], 0), axis=-1)
+    return jnp.where(jnp.any(hit, axis=-1), val, log_term)

@@ -37,6 +37,7 @@ from .kernels import (
     joint_committed,
     joint_vote_result,
     ring_write,
+    ring_write_masked,
     term_at,
 )
 from .state import (
@@ -109,6 +110,17 @@ def empty_msgs(shape: Tuple[int, ...], num_ents: int) -> MsgSlots:
 def _sel(cond, a, b):
     """Tree-select: where(cond, a, b) leafwise (cond is scalar here)."""
     return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)
+
+
+def _pick(vec, at):
+    """vec[s] for a traced s, as compare+reduce (at = peers == s):
+    traced-index gathers serialize on TPU, one-hot reads don't."""
+    return jnp.sum(jnp.where(at, vec, 0), axis=-1)
+
+
+def _pick_b(vec, at):
+    """Bool variant of _pick."""
+    return jnp.any(vec & at, axis=-1)
 
 
 # -----------------------------------------------------------------------------
@@ -418,7 +430,8 @@ def _lane_hb(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
     # MsgTimeoutNow: campaign at once regardless of timers; only
     # promotable instances honor it (raft.go:1465-1472 + hup gating).
     is_ton = m.type == T_TIMEOUT_NOW
-    promotable = _vote_targets(st1)[slot]
+    r = st1.match.shape[-1]
+    promotable = _pick_b(_vote_targets(st1), jnp.arange(r, dtype=I32) == slot)
     st_ton = _campaign(cfg, st1, iid, slot, False, transfer=True)
 
     st_live = _sel(leader_traffic_ok,
@@ -492,17 +505,13 @@ def _handle_append(cfg: BatchedConfig, st: BatchedState, m: MsgSlots):
     j = jnp.arange(e, dtype=I32)
     idx = prev + 1 + j
     have = j < m.n_ents
-    existing = jax.vmap(ta)(idx)
+    existing = ta(idx)
     conflict = have & ((idx > st.last) | (existing != m.ent_terms))
     any_conflict = jnp.any(conflict)
     ci = jnp.argmax(conflict)  # first conflicting offset
 
     write_mask = have & (j >= ci) & any_conflict
-    w = st.log_term.shape[-1]
-    pos = idx % w
-    log = st.log_term.at[pos].set(
-        jnp.where(write_mask, m.ent_terms, st.log_term[pos])
-    )
+    log = ring_write_masked(st.log_term, prev + 1, m.ent_terms, write_mask)
     last = jnp.where(any_conflict, prev + m.n_ents, st.last)
     lastnewi = prev + m.n_ents
     commit = jnp.maximum(st.commit, jnp.minimum(m.commit, lastnewi))
@@ -565,7 +574,8 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
     r = st.match.shape[-1]
     peers = jnp.arange(r, dtype=I32)
     at_s = peers == s
-    prog_ok = _repl_targets(st)[s]  # progress exists for voters+learners
+    prog_ok = _pick_b(_repl_targets(st), at_s)  # progress exists for
+    # voters+learners
 
     st = st._replace(recent_active=jnp.where(at_s, True, st.recent_active))
 
@@ -578,13 +588,14 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
         ),
         m.reject_hint,
     )
-    in_repl = st.pr_state[s] == REPLICATE
+    match_s, next_s = _pick(st.match, at_s), _pick(st.next, at_s)
+    in_repl = _pick(st.pr_state, at_s) == REPLICATE
     stale_rej = jnp.where(
-        in_repl, m.index <= st.match[s], st.next[s] - 1 != m.index
+        in_repl, m.index <= match_s, next_s - 1 != m.index
     )
     dec_next = jnp.where(
         in_repl,
-        st.match[s] + 1,
+        match_s + 1,
         jnp.maximum(jnp.minimum(m.index, hint + 1), 1),
     )
     # On a genuine rejection a replicating peer drops to probing
@@ -600,8 +611,8 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
     st_rej = _sel(stale_rej, st, st_rej)
 
     # --- accepted: MaybeUpdate + state transitions + commit ---
-    old_paused = _paused(cfg, st)[s]
-    updated = st.match[s] < m.index
+    old_paused = _pick_b(_paused(cfg, st), at_s)
+    updated = match_s < m.index
     match = jnp.where(at_s, jnp.maximum(st.match, m.index), st.match)
     nxt = jnp.where(at_s, jnp.maximum(st.next, m.index + 1), st.next)
     st_acc = st._replace(
@@ -610,9 +621,11 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
         probe_sent=jnp.where(at_s & updated, False, st.probe_sent),
     )
 
-    was_probe = st.pr_state[s] == PROBE
-    was_snap = (st.pr_state[s] == SNAPSHOT) & (
-        match[s] >= st.pending_snapshot[s]
+    pr_state_s = _pick(st.pr_state, at_s)
+    new_match_s = jnp.maximum(match_s, m.index)
+    was_probe = pr_state_s == PROBE
+    was_snap = (pr_state_s == SNAPSHOT) & (
+        new_match_s >= _pick(st.pending_snapshot, at_s)
     )
     to_replicate = updated & (was_probe | was_snap)
     st_acc = st_acc._replace(
@@ -624,7 +637,7 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
             at_s & updated, 0, st_acc.inflight
         ),  # count+watermark degeneration of FreeLE
         next=jnp.where(
-            at_s & to_replicate, match[s] + 1, nxt
+            at_s & to_replicate, new_match_s + 1, nxt
         ),
     )
     committed_before = st_acc.commit
@@ -632,7 +645,7 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
     advanced = st_acc.commit > committed_before
     # bcastAppend on commit advance; resend to a previously-paused peer;
     # keep draining while entries remain (ref: raft.go:1259-1276).
-    more = st_acc.last >= st_acc.next[s]
+    more = st_acc.last >= _pick(st_acc.next, at_s)
     st_acc = st_acc._replace(
         send_append=jnp.where(
             advanced,
@@ -676,7 +689,7 @@ def _leader_hb_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
         read_acks=acks,
         read_ready=st2.read_ready | (pending & confirmed),
     )
-    return _sel(_repl_targets(st)[s], st2, st)
+    return _sel(_pick_b(_repl_targets(st), at_s), st2, st)
 
 
 def _candidate_vote_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
@@ -789,7 +802,7 @@ def _tick(cfg: BatchedConfig, iid, slot, st: BatchedState, do_tick,
 
     # Follower/candidate election firing (hup gated on promotability —
     # learners never campaign, ref: raft.go:760-784).
-    promotable = _vote_targets(st)[slot]
+    promotable = _pick_b(_vote_targets(st), peers == slot)
     fire = (
         (~is_leader & (ee >= st.randomized_timeout)) | do_campaign
     ) & promotable & (st.role != LEADER)
@@ -820,7 +833,7 @@ def _control(cfg: BatchedConfig, slot, st: BatchedState, transfer_to,
         & (transfer_to > 0)
         & (transfer_to != slot + 1)          # self-transfer is a no-op
         & (transfer_to != st.transferee)     # dup request ignored
-        & _vote_targets(st)[jnp.clip(target, 0, r - 1)]  # learners can't lead
+        & _pick_b(_vote_targets(st), peers == target)  # learners can't lead
     )
     st_tr = st._replace(
         transferee=transfer_to,
@@ -828,8 +841,7 @@ def _control(cfg: BatchedConfig, slot, st: BatchedState, transfer_to,
         election_elapsed=jnp.zeros_like(st.election_elapsed),
         # Last-chance catch-up append (raft.go:1367-1371 sendAppend).
         send_append=st.send_append
-        | ((peers == target) & (st.match[jnp.clip(target, 0, r - 1)]
-                                < st.last)),
+        | ((peers == target) & (st.match < st.last)),
     )
     st = _sel(valid_target, st_tr, st)
 
@@ -946,7 +958,7 @@ def _emit(cfg: BatchedConfig, slot, st: BatchedState):
         is_leader
         & (st.transferee > 0)
         & ~st.transfer_sent
-        & (st.match[jnp.clip(tr, 0, r - 1)] >= st.last)
+        & (st.match >= st.last)  # masked to the transferee's slot below
         & (peers == tr)
     )
     out = out._replace(
@@ -969,7 +981,7 @@ def _emit(cfg: BatchedConfig, slot, st: BatchedState):
     n_send = jnp.clip(st.last - prev, 0, e)  # [R]
     j = jnp.arange(e, dtype=I32)
     ent_idx = prev[:, None] + 1 + j[None, :]  # [R, E]
-    ent_terms = jax.vmap(jax.vmap(ta))(ent_idx)
+    ent_terms = ta(ent_idx)
     ent_mask = j[None, :] < n_send[:, None]
     app = want & ~snap_needed
     snp = want & snap_needed
@@ -982,7 +994,7 @@ def _emit(cfg: BatchedConfig, slot, st: BatchedState):
             jnp.where(snp, st.snap_index, prev)
         ),
         log_term=out.log_term.at[:, KIND_APP].set(
-            jnp.where(snp, st.snap_term, jax.vmap(ta)(prev))
+            jnp.where(snp, st.snap_term, ta(prev))
         ),
         commit=out.commit.at[:, KIND_APP].set(st.commit),
         n_ents=out.n_ents.at[:, KIND_APP].set(jnp.where(app, n_send, 0)),

@@ -1,0 +1,67 @@
+"""Microbench for the batched round kernel: compile time + steady-state
+round rate on a small config, for optimization iteration. Not a test.
+
+Usage: JAX_PLATFORMS=cpu python tests/batched/microbench.py [G] [rounds_per_call]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    groups = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    rpc = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+    from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
+
+    cfg = BatchedConfig(
+        num_groups=groups,
+        num_replicas=3,
+        window=32,
+        max_ents_per_msg=4,
+        max_props_per_round=2,
+        election_timeout=1 << 20,
+        heartbeat_timeout=4,
+        auto_compact=True,
+    )
+    t0 = time.perf_counter()
+    eng = MultiRaftEngine(cfg)
+    eng.campaign([g * cfg.num_replicas for g in range(groups)])
+    t_init = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    eng.run_rounds(rpc, tick=False)
+    jax.block_until_ready(eng.state.commit)
+    t_compile = time.perf_counter() - t0
+    leaders = eng.leaders()
+    assert (leaders == 0).all(), "election failed"
+
+    props = jnp.zeros((cfg.num_instances,), jnp.int32)
+    props = props.at[jnp.arange(groups) * cfg.num_replicas].set(2)
+
+    # warm the ticked program too
+    t0 = time.perf_counter()
+    eng.run_rounds(rpc, tick=True, propose_n=props)
+    jax.block_until_ready(eng.state.commit)
+    t_compile2 = time.perf_counter() - t0
+
+    calls = 6
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        eng.run_rounds(rpc, tick=True, propose_n=props)
+    jax.block_until_ready(eng.state.commit)
+    dt = time.perf_counter() - t0
+    rate = groups * rpc * calls / dt
+    assert eng.commits().min() > 0
+    print(
+        f"G={groups} rpc={rpc} init={t_init:.1f}s "
+        f"compile={t_compile:.1f}s+{t_compile2:.1f}s "
+        f"round={dt/(rpc*calls)*1e3:.2f}ms rate={rate:,.0f} group-rounds/s"
+    )
+
+
+if __name__ == "__main__":
+    main()
