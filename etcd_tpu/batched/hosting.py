@@ -492,8 +492,12 @@ class MultiRaftCluster:
                 return leads
             if time.monotonic() >= next_nudge:
                 stuck = np.nonzero(leads == 0)[0]
-                first = next(iter(self.members.values()))
-                first.campaign(stuck)
+                # Campaign the stuck groups on every member: any single
+                # member's replica may be unelectable (shorter log after
+                # a restart); pre-vote keeps the extra campaigns from
+                # disrupting groups that elect meanwhile.
+                for m in self.members.values():
+                    m.campaign(stuck)
                 next_nudge = time.monotonic() + 5.0
             time.sleep(0.05)
         raise TimeoutError("groups without leader")

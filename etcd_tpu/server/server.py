@@ -384,6 +384,18 @@ class EtcdServer:
 
     def _ready_loop(self) -> None:
         """ref: etcdserver/raft.go:158-315 raftNode.start."""
+        try:
+            self._ready_loop_inner()
+        except failpoint.FailpointPanic:
+            # gofail-style panic: the ready loop "crashes" — no cleanup,
+            # no WAL flush (the reference's panic() kills the process;
+            # ref: etcdserver/raft.go:222-265 gofail sites). The chaos
+            # harness detects the dead thread and kill()s + restarts the
+            # member; stop()/kill() still runs the full teardown, so the
+            # stopped flag is deliberately NOT set here.
+            return
+
+    def _ready_loop_inner(self) -> None:
         islead = False
         while not self._stopped.is_set():
             rd = self.node.ready(timeout=0.1)

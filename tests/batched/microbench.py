@@ -1,7 +1,7 @@
 """Microbench for the batched round kernel: compile time + steady-state
 round rate on a small config, for optimization iteration. Not a test.
 
-Usage: JAX_PLATFORMS=cpu python tests/batched/microbench.py [G] [rounds_per_call]
+Usage: JAX_PLATFORMS=cpu python tests/batched/microbench.py [G] [rounds_per_call] [major|minor]
 """
 
 import sys
@@ -14,6 +14,7 @@ import jax.numpy as jnp
 def main() -> None:
     groups = int(sys.argv[1]) if len(sys.argv) > 1 else 512
     rpc = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    layout = sys.argv[3] if len(sys.argv) > 3 else "major"
 
     from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
 
@@ -26,6 +27,7 @@ def main() -> None:
         election_timeout=1 << 20,
         heartbeat_timeout=4,
         auto_compact=True,
+        lanes_minor=layout == "minor",
     )
     t0 = time.perf_counter()
     eng = MultiRaftEngine(cfg)

@@ -1,0 +1,85 @@
+"""Phase-cost probe for the batched round on the current backend: times
+(a) full round (step + route), (b) step only, (c) route only — to show
+where round wall-time goes. Not a test.
+
+Usage: python tests/batched/phaseprobe.py [G] [minor|major]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    groups = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    layout = sys.argv[2] if len(sys.argv) > 2 else "minor"
+
+    from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
+    from etcd_tpu.batched.step import route
+
+    cfg = BatchedConfig(
+        num_groups=groups, num_replicas=3, window=32, max_ents_per_msg=4,
+        max_props_per_round=2, election_timeout=1 << 20, heartbeat_timeout=4,
+        auto_compact=True, lanes_minor=layout == "minor",
+    )
+    eng = MultiRaftEngine(cfg)
+    eng.campaign([g * 3 for g in range(groups)])
+    eng.run_rounds(4, tick=False)
+    assert (eng.leaders() == 0).all()
+    props = jnp.zeros((cfg.num_instances,), jnp.int32)
+    props = props.at[jnp.arange(groups) * 3].set(2)
+    n = cfg.num_instances
+    ticks = jnp.ones((n,), bool)
+    zb = jnp.zeros((n,), bool)
+
+    rounds = 16
+
+    def loop_full(st, inbox):
+        def body(c, _):
+            st, inbox = c
+            st, out = eng._step(st, inbox, ticks, zb, props, zb)
+            return (st, route(cfg, out)), None
+        return jax.lax.scan(body, (st, inbox), None, length=rounds)[0]
+
+    def loop_step(st, inbox):
+        def body(c, _):
+            st, _inbox = c
+            st, out = eng._step(st, _inbox, ticks, zb, props, zb)
+            # feed outbox fields straight back (no transpose) to keep
+            # shapes; semantics are garbage, timing is what matters
+            return (st, _inbox), None
+        return jax.lax.scan(body, (st, inbox), None, length=rounds)[0]
+
+    def loop_route(st, inbox):
+        # One route per iteration with an elementwise perturbation in
+        # between, so XLA cannot cancel transpose pairs across
+        # iterations (route(route(x)) is an exact identity).
+        def body(c, i):
+            st, inbox = c
+            inbox = inbox._replace(term=inbox.term + i)
+            return (st, route(cfg, inbox)), None
+        return jax.lax.scan(
+            body, (st, inbox), jnp.arange(rounds, dtype=jnp.int32)
+        )[0]
+
+    for name, fn in (("full", loop_full), ("step", loop_step),
+                     ("route2x", loop_route)):
+        jfn = jax.jit(fn)
+        t0 = time.perf_counter()
+        out = jfn(eng.state, eng.inbox)
+        jax.block_until_ready(out[0].commit)
+        tc = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        calls = 4
+        for _ in range(calls):
+            out = jfn(eng.state, eng.inbox)
+        jax.block_until_ready(out[0].commit)
+        dt = (time.perf_counter() - t0) / (rounds * calls)
+        print(f"{name}: compile={tc:.1f}s per-round={dt*1e3:.2f}ms",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
