@@ -142,6 +142,9 @@ class ObserveHandle:
             pass
         with self.c._lock:
             self.c._observes.pop(self.observe_id, None)
+            # In-flight frames pushed before the cancel was processed
+            # would otherwise park in the early buffer forever.
+            self.c._observe_early.pop(self.observe_id, None)
         with self._cv:
             self._cv.notify_all()
 
@@ -180,6 +183,10 @@ class Client:
         self._pending: Dict[int, _Pending] = {}
         self._watches: Dict[int, WatchHandle] = {}
         self._observes: Dict[int, "ObserveHandle"] = {}
+        # Observe frames that raced ahead of the Observe response (the
+        # server pump can push before the observe_id reaches us); keyed
+        # by ostream id, drained when observe() registers the handle.
+        self._observe_early: Dict[int, list] = {}
         self._closed = False
         self._reconnect_gen = 0
 
@@ -260,6 +267,15 @@ class Client:
             if "ostream" in frame:
                 with self._lock:
                     oh = self._observes.get(frame["ostream"])
+                    # Buffer only for the live connection: observe ids
+                    # restart per connection, and a dead loop draining
+                    # its socket tail must not seed the next
+                    # connection's ids with stale leader kvs.
+                    if oh is None and self._reconnect_gen == gen:
+                        buf = self._observe_early.setdefault(
+                            frame["ostream"], [])
+                        if len(buf) < 64:
+                            buf.append(frame["kv"])
                 if oh is not None:
                     oh._push(wire.dec_kv(frame["kv"]))
                 continue
@@ -277,6 +293,7 @@ class Client:
             self._sock = None
             pend = list(self._pending.values())
             self._pending.clear()
+            self._observe_early.clear()  # ids are per-connection
         for p in pend:
             p.error = {"type": "ConnectionError", "msg": "connection lost"}
             p.ev.set()
@@ -565,7 +582,12 @@ class Client:
         resp = self._request("Observe", {"name": name.hex()})
         oh = ObserveHandle(self, resp["observe_id"])
         with self._lock:
+            # Drain the early buffer under the same lock that registers
+            # the handle: once registered, the read loop pushes directly,
+            # and a direct push must not overtake older buffered frames.
             self._observes[oh.observe_id] = oh
+            for kv in self._observe_early.pop(oh.observe_id, []):
+                oh._push(wire.dec_kv(kv))
         return oh
 
     # -- auth ------------------------------------------------------------------

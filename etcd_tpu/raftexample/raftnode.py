@@ -135,7 +135,11 @@ class ExampleRaftNode:
                 self.node = Node.start(cfg, [Peer(id=p) for p in peers])
 
         self.storage = ServerStorage(self.wal, self.snapshotter)
-        network.register(node_id, self._receive)
+        network.register(
+            node_id, self._receive,
+            reporter=lambda vid, failure: self.node.report_snapshot(
+                vid, failure),
+        )
 
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
         self._server = threading.Thread(target=self._serve_loop, daemon=True)

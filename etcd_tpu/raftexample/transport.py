@@ -14,7 +14,7 @@ import time
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
-from ..raft.types import Message
+from ..raft.types import Message, MessageType
 
 MAX_PENDING = 4096
 
@@ -23,6 +23,7 @@ class InProcNetwork:
     def __init__(self, seed: int = 0) -> None:
         self._lock = threading.Lock()
         self._handlers: Dict[int, Callable[[Message], None]] = {}
+        self._reporters: Dict[int, Callable[[int, bool], None]] = {}
         self._queues: Dict[int, "queue.Queue[Message]"] = {}
         self._pumps: Dict[int, threading.Thread] = {}
         self._isolated: Set[int] = set()
@@ -36,13 +37,23 @@ class InProcNetwork:
         self._rand = random.Random(seed)
         self._stopped = False
 
-    def register(self, node_id: int, handler: Callable[[Message], None]) -> None:
+    def register(self, node_id: int, handler: Callable[[Message], None],
+                 reporter=None) -> None:
         """Attach a node; messages to `node_id` are pumped on a dedicated
-        thread to preserve per-peer ordering without blocking senders."""
+        thread to preserve per-peer ordering without blocking senders.
+
+        `reporter` (optional) receives snapshot delivery outcomes:
+        ``reporter(to_id, failure: bool)`` — the in-proc analog of
+        rafthttp's snapshot sender always reporting finish/failure to
+        the sender's raft (ref: rafthttp/snapshot_sender.go:200,
+        raft.go:1316-1331 MsgSnapStatus), which is what unsticks a
+        StateSnapshot progress when the receiver crashes mid-install."""
         with self._lock:
             if self._stopped:
                 return
             self._handlers[node_id] = handler
+            if reporter is not None:
+                self._reporters[node_id] = reporter
             if node_id not in self._queues:
                 q: "queue.Queue[Message]" = queue.Queue(maxsize=MAX_PENDING)
                 self._queues[node_id] = q
@@ -55,6 +66,19 @@ class InProcNetwork:
     def unregister(self, node_id: int) -> None:
         with self._lock:
             self._handlers.pop(node_id, None)
+            self._reporters.pop(node_id, None)
+
+    def _report_snap(self, m: Message, failure: bool) -> None:
+        """Tell the sender's raft how its MsgSnap delivery went."""
+        if m.type != MessageType.MsgSnap:
+            return
+        with self._lock:
+            rep = self._reporters.get(m.from_)
+        if rep is not None:
+            try:
+                rep(m.to, failure)
+            except Exception:  # noqa: BLE001 — sender may be stopping
+                pass
 
     def send(self, from_id: int, msgs: List[Message]) -> None:
         for m in msgs:
@@ -64,30 +88,35 @@ class InProcNetwork:
         with self._lock:
             if self._stopped:
                 return
-            if from_id in self._isolated or m.to in self._isolated:
-                return
-            if self._rand.random() < self._dropped.get((from_id, m.to), 0.0):
-                return
-            dly = self._delayed.get((from_id, m.to))
+            drop = (
+                from_id in self._isolated
+                or m.to in self._isolated
+                or self._rand.random() < self._dropped.get(
+                    (from_id, m.to), 0.0)
+            )
             delay_s = 0.0
-            if dly:
-                now = time.monotonic()
-                at = now + dly[0] + self._rand.random() * dly[1]
-                # FIFO floor: a later message never overtakes an
-                # earlier one on the same link, jitter or not.
-                key = (from_id, m.to)
-                at = max(at, self._delay_floor.get(key, 0.0))
-                self._delay_floor[key] = at
-                delay_s = at - now
-            q = self._queues.get(m.to)
-        if q is None:
+            q = None
+            if not drop:
+                dly = self._delayed.get((from_id, m.to))
+                if dly:
+                    now = time.monotonic()
+                    at = now + dly[0] + self._rand.random() * dly[1]
+                    # FIFO floor: a later message never overtakes an
+                    # earlier one on the same link, jitter or not.
+                    key = (from_id, m.to)
+                    at = max(at, self._delay_floor.get(key, 0.0))
+                    self._delay_floor[key] = at
+                    delay_s = at - now
+                q = self._queues.get(m.to)
+        if drop or q is None:
+            self._report_snap(m, failure=True)
             return
 
         def put() -> None:
             try:
                 q.put_nowait(m)  # drop, never block (rafthttp semantics)
             except queue.Full:
-                pass
+                self._report_snap(m, failure=True)
 
         if delay_s > 0:
             t = threading.Timer(delay_s, put)
@@ -106,11 +135,15 @@ class InProcNetwork:
                 stopped = self._stopped
             if stopped:
                 return
-            if h is not None:
-                try:
-                    h(m)
-                except Exception:  # noqa: BLE001 — a dead node mustn't kill the pump
-                    pass
+            if h is None:
+                self._report_snap(m, failure=True)
+                continue
+            try:
+                h(m)
+            except Exception:  # noqa: BLE001 — a dead node mustn't kill the pump
+                self._report_snap(m, failure=True)
+            else:
+                self._report_snap(m, failure=False)
 
     # -- fault injection (ref: rafttest/network.go:33-46) ----------------------
 

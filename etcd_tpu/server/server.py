@@ -251,7 +251,11 @@ class EtcdServer:
             if cfg.corrupt_check_time > 0:
                 self.corruption_checker.start_periodic(cfg.corrupt_check_time)
 
-        self.network.register(self.id, self._receive_message)
+        self.network.register(
+            self.id, self._receive_message,
+            reporter=lambda vid, failure: self.node.report_snapshot(
+                vid, failure),
+        )
         self._ready_thread = threading.Thread(
             target=self._ready_loop, daemon=True, name=f"ready-{self.id}"
         )
@@ -514,7 +518,14 @@ class EtcdServer:
             )
         smet.snapshot_apply_in_progress.set(1)
         try:
-            task.persisted.wait()  # snapshot durable before opening it
+            # Snapshot must be durable before opening it. A ready loop
+            # that crashed mid-persist (failpoint panic) never sets the
+            # event; bail on stop so teardown's scheduler join cannot
+            # deadlock — not applying an unpersisted snapshot is exactly
+            # crash semantics.
+            while not task.persisted.wait(0.05):
+                if self._stopped.is_set():
+                    return
             payload = json.loads(snap.data.decode())
             db_bytes = bytes.fromhex(payload["db"])
             newdb = os.path.join(

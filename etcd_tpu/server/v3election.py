@@ -124,9 +124,15 @@ class ElectionServer:
                 last_mod = kv.mod_revision
                 if not push(kv):
                     return
+            # Hold ONE watch across idle polls: tearing it down every
+            # interval opens re-establishment gaps under load (events
+            # between cancel and re-watch surface only via the next
+            # leader-kv poll, delaying pushes unboundedly).
             h = c.watch(pfx, range_end=prefix_end(pfx),
                         start_rev=(kv.mod_revision + 1 if kv else 0))
             try:
-                h.get(timeout=0.5)
+                while not stopped.is_set():
+                    if h.get(timeout=0.5) is not None:
+                        break  # change seen — re-read the leader kv
             finally:
                 h.cancel()

@@ -205,12 +205,35 @@ class TCPTransport:
 
     # -- the network interface used by EtcdServer ------------------------------
 
-    def register(self, node_id: int, handler: Callable[[Message], None]) -> None:
+    def register(self, node_id: int, handler: Callable[[Message], None],
+                 reporter=None) -> None:
         assert node_id == self.member_id, "TCPTransport is per-member"
         self._handler = handler
+        if reporter is not None and self._raft_reporter is None:
+            # Wire snapshot-status reporting immediately so a server
+            # that never calls set_raft_reporter (the richer node-object
+            # path, which also feeds ReportUnreachable and overwrites
+            # this) still unsticks StateSnapshot progress on failures.
+            class _SnapOnly:
+                @staticmethod
+                def report_snapshot(vid: int, failure: bool) -> None:
+                    reporter(vid, failure)
+
+                @staticmethod
+                def report_unreachable(vid: int) -> None:
+                    pass
+
+            self._raft_reporter = _SnapOnly()
+            self._reporter_from_register = True
 
     def unregister(self, node_id: int) -> None:
         self._handler = None
+        if getattr(self, "_reporter_from_register", False):
+            # Drop the register()-installed reporter so a server
+            # re-registered on this transport wires its OWN node, not
+            # the dead predecessor's.
+            self._raft_reporter = None
+            self._reporter_from_register = False
 
     def send(self, _from_id: int, msgs: List[Message]) -> None:
         """ref: transport.go:175 Send — route each message to its peer."""

@@ -271,6 +271,54 @@ class TestSnapshotCatchupCases:
         assert s.applied_index() >= lead.applied_index()
         hash_check(c.alive())
 
+    def test_failpoint_panic_during_snapshot_persist(self, snap_cluster):
+        """A ready loop that panics at raftBeforeSaveSnap (mid snapshot
+        catch-up) must not wedge teardown: the scheduled snapshot apply
+        waits on a persisted event that will never be set, and kill()
+        joins that worker — the stop-aware wait keeps it bounded."""
+        import threading
+
+        c = snap_cluster
+        lead = c.wait_leader()
+        victim = c.followers()[0].id
+        c.kill(victim)
+        for i in range(40):
+            lead.put(PutRequest(key=b"k%d" % i, value=b"v%d" % i))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if lead.raft_storage.first_index() > 10:
+                break
+            time.sleep(0.05)
+        assert lead.raft_storage.first_index() > 10
+
+        # The restarted member's first snapshot-carrying Ready panics.
+        failpoint.enable("raftBeforeSaveSnap", "panic")
+        s = c.restart(victim)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if not s._ready_thread.is_alive():
+                break
+            time.sleep(0.05)
+        assert not s._ready_thread.is_alive(), \
+            "snapshot failpoint never tripped"
+        failpoint.disable("raftBeforeSaveSnap")
+
+        # kill() must complete despite the orphaned apply task.
+        done = threading.Event()
+        threading.Thread(target=lambda: (c.kill(victim), done.set()),
+                         daemon=True).start()
+        assert done.wait(15), "teardown deadlocked on the apply worker"
+
+        s = c.restart(victim)
+        lead = c.wait_leader()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if s.applied_index() >= lead.applied_index():
+                break
+            time.sleep(0.05)
+        assert s.applied_index() >= lead.applied_index()
+        hash_check(c.alive())
+
 
 class TestFiveMemberCases:
     """Larger quorum geometry (the functional suite runs 5-member
