@@ -217,6 +217,11 @@ class Client:
             self._sock = sock
             self._reconnect_gen += 1
             gen = self._reconnect_gen
+            # Observe ids restart per connection; buffered frames from
+            # the previous connection must not seed the new one's ids
+            # (the dying read loop skips its own clear when it exits on
+            # a generation mismatch).
+            self._observe_early.clear()
         threading.Thread(
             target=self._read_loop, args=(sock, gen), daemon=True
         ).start()

@@ -496,7 +496,11 @@ class EtcdServer:
     def _apply_all(self, task: _ApplyTask) -> None:
         """ref: server.go:903 applyAll."""
         t0 = time.monotonic()
-        self._apply_snapshot(task)
+        if not self._apply_snapshot(task):
+            # Stop-aborted while waiting for snapshot persistence: the
+            # entries after the snapshot cannot apply either (applied
+            # never reached snap.index); abandon the whole task.
+            return
         self._apply_entries(task)
         dt = time.monotonic() - t0
         smet.apply_duration.observe(dt)
@@ -505,11 +509,13 @@ class EtcdServer:
         self.apply_wait.trigger(self._applied_index)
         self._maybe_trigger_snapshot()
 
-    def _apply_snapshot(self, task: _ApplyTask) -> None:
+    def _apply_snapshot(self, task: _ApplyTask) -> bool:
         """Receive a full-state snapshot: reopen the backend from the
-        shipped db (ref: server.go:925-1040 applySnapshot)."""
+        shipped db (ref: server.go:925-1040 applySnapshot). Returns
+        False when aborted by stop (the rest of the task must not
+        apply)."""
         if is_empty_snap(task.snapshot):
-            return
+            return True
         snap = task.snapshot
         if snap.metadata.index <= self._applied_index:
             raise RuntimeError(
@@ -525,7 +531,7 @@ class EtcdServer:
             # crash semantics.
             while not task.persisted.wait(0.05):
                 if self._stopped.is_set():
-                    return
+                    return False
             payload = json.loads(snap.data.decode())
             db_bytes = bytes.fromhex(payload["db"])
             newdb = os.path.join(

@@ -103,12 +103,17 @@ class ElectionServer:
 
     def _leader_kv(self, name: bytes,
                    token: Optional[str]) -> Optional[sapi.KeyValue]:
+        kv, _rev = self._leader_kv_at(name, token)
+        return kv
+
+    def _leader_kv_at(self, name: bytes, token: Optional[str]):
+        """(leader kv or None, revision of the read)."""
         pfx = name.rstrip(b"/") + b"/"
         rr = self._client(token).get(
             pfx, range_end=prefix_end(pfx), limit=1,
             sort_order=sapi.SortOrder.ASCEND,
             sort_target=sapi.SortTarget.CREATE)
-        return rr.kvs[0] if rr.kvs else None
+        return (rr.kvs[0] if rr.kvs else None), rr.header.revision
 
     def observe(self, name: bytes, push: Callable[[sapi.KeyValue], bool],
                 stopped, token: Optional[str] = None) -> None:
@@ -119,7 +124,7 @@ class ElectionServer:
         pfx = name.rstrip(b"/") + b"/"
         last_mod = 0
         while not stopped.is_set():
-            kv = self._leader_kv(name, token)
+            kv, read_rev = self._leader_kv_at(name, token)
             if kv is not None and kv.mod_revision > last_mod:
                 last_mod = kv.mod_revision
                 if not push(kv):
@@ -127,9 +132,13 @@ class ElectionServer:
             # Hold ONE watch across idle polls: tearing it down every
             # interval opens re-establishment gaps under load (events
             # between cancel and re-watch surface only via the next
-            # leader-kv poll, delaying pushes unboundedly).
+            # leader-kv poll, delaying pushes unboundedly). Watch from
+            # the READ's revision, never "from now" — with no leader, a
+            # campaign landing between the read and the watch would
+            # otherwise go unseen for as long as the leader stays quiet.
             h = c.watch(pfx, range_end=prefix_end(pfx),
-                        start_rev=(kv.mod_revision + 1 if kv else 0))
+                        start_rev=(kv.mod_revision + 1 if kv
+                                   else read_rev + 1))
             try:
                 while not stopped.is_set():
                     if h.get(timeout=0.5) is not None:

@@ -1,0 +1,190 @@
+"""Ports of the reference's MsgApp flow-control and snapshot-progress
+suites (ref: raft/raft_flow_control_test.go:27-156,
+raft/raft_snap_test.go:33-141) against the single-group core."""
+
+from etcd_tpu.raft.types import (
+    ConfState,
+    Entry,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+)
+
+from .test_paper import new_test_raft, new_test_storage, read_messages
+
+
+def _replicating_leader(peers, max_inflight=None):
+    r = new_test_raft(1, 5, 1, new_test_storage(peers))
+    r.become_candidate()
+    r.become_leader()
+    pr2 = r.prs.progress[2]
+    pr2.become_replicate()
+    return r, pr2
+
+
+def _propose(r):
+    r.step(
+        Message(
+            from_=1, to=1, type=MessageType.MsgProp,
+            entries=[Entry(data=b"somedata")],
+        )
+    )
+    return read_messages(r)
+
+
+def test_msgapp_flow_control_full():
+    """The sending window fills, then blocks
+    (ref: raft_flow_control_test.go:27-57)."""
+    r, pr2 = _replicating_leader([1, 2])
+    for i in range(r.prs.max_inflight):
+        ms = _propose(r)
+        assert len(ms) == 1, (i, ms)
+
+    assert pr2.inflights.full()
+
+    for _ in range(10):
+        assert _propose(r) == []
+
+
+def test_msgapp_flow_control_move_forward():
+    """Valid MsgAppResp indexes slide the window; stale ones don't
+    (ref: raft_flow_control_test.go:63-102)."""
+    r, pr2 = _replicating_leader([1, 2])
+    for _ in range(r.prs.max_inflight):
+        _propose(r)
+
+    # 1 is the leader's noop, 2 the first proposal: start at 2.
+    for tt in range(2, r.prs.max_inflight):
+        r.step(Message(from_=2, to=1, type=MessageType.MsgAppResp, index=tt))
+        read_messages(r)
+
+        ms = _propose(r)
+        assert len(ms) == 1, (tt, ms)
+        assert pr2.inflights.full()
+
+        for i in range(tt):
+            r.step(
+                Message(from_=2, to=1, type=MessageType.MsgAppResp, index=i)
+            )
+            assert pr2.inflights.full(), (tt, i)
+
+
+def test_msgapp_flow_control_recv_heartbeat():
+    """A heartbeat response frees exactly one slot of a full window
+    (ref: raft_flow_control_test.go:108-156)."""
+    r, pr2 = _replicating_leader([1, 2])
+    for _ in range(r.prs.max_inflight):
+        _propose(r)
+
+    for tt in range(1, 5):
+        assert pr2.inflights.full(), tt
+
+        for i in range(tt):
+            r.step(
+                Message(from_=2, to=1, type=MessageType.MsgHeartbeatResp)
+            )
+            read_messages(r)
+            assert not pr2.inflights.full(), (tt, i)
+
+        ms = _propose(r)
+        assert len(ms) == 1, tt
+        for i in range(10):
+            assert _propose(r) == [], (tt, i)
+
+        r.step(Message(from_=2, to=1, type=MessageType.MsgHeartbeatResp))
+        read_messages(r)
+
+
+# -- snapshot progress (raft_snap_test.go) ------------------------------------
+
+TESTING_SNAP = Snapshot(
+    metadata=SnapshotMetadata(
+        index=11, term=11, conf_state=ConfState(voters=[1, 2])
+    )
+)
+
+
+def _snap_leader(peers):
+    sm = new_test_raft(1, 10, 1, new_test_storage(peers))
+    sm.restore(TESTING_SNAP)
+    sm.become_candidate()
+    sm.become_leader()
+    return sm
+
+
+def test_sending_snapshot_set_pending_snapshot():
+    """A rejected probe below the log floor switches the peer to the
+    snapshot path (ref: raft_snap_test.go:33-48)."""
+    sm = _snap_leader([1])
+    sm.prs.progress[2].next = sm.raft_log.first_index()
+
+    sm.step(
+        Message(
+            from_=2, to=1, type=MessageType.MsgAppResp,
+            index=sm.prs.progress[2].next - 1, reject=True,
+        )
+    )
+    assert sm.prs.progress[2].pending_snapshot == 11
+
+
+def test_pending_snapshot_pause_replication():
+    """ref: raft_snap_test.go:51-65."""
+    sm = _snap_leader([1, 2])
+    sm.prs.progress[2].become_snapshot(11)
+
+    sm.step(
+        Message(
+            from_=1, to=1, type=MessageType.MsgProp,
+            entries=[Entry(data=b"somedata")],
+        )
+    )
+    assert read_messages(sm) == []
+
+
+def test_snapshot_failure():
+    """A failed snapshot report resets pending and probes from match+1
+    (ref: raft_snap_test.go:68-88)."""
+    sm = _snap_leader([1, 2])
+    sm.prs.progress[2].next = 1
+    sm.prs.progress[2].become_snapshot(11)
+
+    sm.step(
+        Message(from_=2, to=1, type=MessageType.MsgSnapStatus, reject=True)
+    )
+    pr2 = sm.prs.progress[2]
+    assert pr2.pending_snapshot == 0
+    assert pr2.next == 1
+    assert pr2.probe_sent
+
+
+def test_snapshot_succeed():
+    """A successful snapshot report probes from the snapshot index
+    (ref: raft_snap_test.go:91-111)."""
+    sm = _snap_leader([1, 2])
+    sm.prs.progress[2].next = 1
+    sm.prs.progress[2].become_snapshot(11)
+
+    sm.step(
+        Message(from_=2, to=1, type=MessageType.MsgSnapStatus, reject=False)
+    )
+    pr2 = sm.prs.progress[2]
+    assert pr2.pending_snapshot == 0
+    assert pr2.next == 12
+    assert pr2.probe_sent
+
+
+def test_snapshot_abort():
+    """A MsgAppResp at/above the pending snapshot aborts it and resumes
+    replication optimistically (ref: raft_snap_test.go:114-141)."""
+    sm = _snap_leader([1, 2])
+    sm.prs.progress[2].next = 1
+    sm.prs.progress[2].become_snapshot(11)
+
+    sm.step(Message(from_=2, to=1, type=MessageType.MsgAppResp, index=11))
+    pr2 = sm.prs.progress[2]
+    assert pr2.pending_snapshot == 0
+    # Next 13 (not 12): the leader appended an empty entry at 12 on
+    # election and sends it optimistically on the resumed stream.
+    assert pr2.next == 13
+    assert pr2.inflights.count() == 1
