@@ -104,6 +104,8 @@ class BatchedNode:
         self._inbound_snaps: Dict[int, Snapshot] = {}
         # Host-side proposal forwards waiting for the next Ready.
         self._fwd: List[Message] = []
+        # Last emitted SoftState (Ready carries it only on change).
+        self._last_soft: Optional[SoftState] = None
         # ReadIndex waiters not yet bound to a device batch, and the
         # per-batch bindings (seq -> waiters). A waiter is only ever
         # served by a batch that opened at-or-after its request, so the
@@ -273,6 +275,14 @@ class BatchedNode:
             # Ready carries the snapshot to the host for restore.
             with self._lock:
                 self._inbound_snaps[m.snapshot.metadata.index] = m.snapshot
+            # The sender's ring floor (m.index) may sit BELOW the
+            # attached app snapshot (compaction keeps a catch-up margin;
+            # the app state is serialized at applied). Install at the
+            # app snapshot's index — its state supersedes the log
+            # entries in between, and the confirm/stash keys then agree.
+            if m.snapshot.metadata.index > m.index:
+                m.index = m.snapshot.metadata.index
+                m.log_term = m.snapshot.metadata.term
         self.rn.step(0, m)
         self._work.set()
 
@@ -397,8 +407,20 @@ class BatchedNode:
             vote=int(self.rn._round[1][0]),
             commit=int(self.rn._round[2][0]),
         )
+        # SoftState rides the Ready only when it changed — the
+        # reference's newReady contract (raft/node.go:564-584), which
+        # is how EtcdServer learns leadership transitions.
+        soft = SoftState(
+            lead=self.rn.lead(0),
+            raft_state=StateType(int(self.rn.m_role[0])),
+        )
+        soft_out = None
+        if self._last_soft is None or not soft.equal(self._last_soft):
+            self._last_soft = soft
+            soft_out = soft
         rd_out = Ready(
             hard_state=hs if rd.hardstates else HardState(),
+            soft_state=soft_out,
             entries=entries,
             snapshot=snapshot,
             committed_entries=committed,

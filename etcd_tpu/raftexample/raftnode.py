@@ -287,7 +287,14 @@ class ExampleRaftNode:
                 self.applied_index, self.confstate, data
             )
             self.storage.save_snap(snap)
-            self.node.compact(self.applied_index, snap)
+            # Catch-up margin below the floor, like the host path: a
+            # slightly-lagging follower replays entries instead of
+            # taking a full snapshot (ref: raftexample/raft.go
+            # snapshotCatchUpEntriesN).
+            margin = min(SNAPSHOT_CATCHUP_ENTRIES,
+                         self.node.cfg.window // 8)
+            self.node.compact(
+                max(1, self.applied_index - margin), snap)
         else:
             snap = self.raft_storage.create_snapshot(
                 self.applied_index, self.confstate, data

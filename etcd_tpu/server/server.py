@@ -151,6 +151,11 @@ class ServerConfig:
     peer_hash_fetcher: Any = None
     initial_corrupt_check: bool = False
     corrupt_check_time: float = 0.0  # seconds; 0 → no periodic monitor
+    # Raft implementation behind the Node contract: "host" = the
+    # reference-shaped Python core, "tpu" = the batched device engine
+    # (requires dense member ids 1..R; ref: SURVEY §7.6
+    # --experimental-raft-backend plumbing at bootstrapRaft).
+    raft_backend: str = "host"
 
 
 @dataclass
@@ -311,6 +316,8 @@ class EtcdServer:
 
         old_wal = WAL.exists(self.wal_dir)
         snap = Snapshot()
+        hs = None
+        ents: List[Entry] = []
         if old_wal:
             try:
                 snap = self.snapshotter.load()
@@ -320,7 +327,8 @@ class EtcdServer:
             walsnap = WalSnapshot(index=snap.metadata.index, term=snap.metadata.term)
             _meta, hs, ents = self.wal.read_all(walsnap)
             if not is_empty_snap(snap):
-                self.raft_storage.apply_snapshot(snap)
+                if self.cfg.raft_backend != "tpu":  # device holds the log
+                    self.raft_storage.apply_snapshot(snap)
                 self.confstate = snap.metadata.conf_state
                 try:
                     v2blob = json.loads(snap.data.decode()).get("v2")
@@ -328,8 +336,9 @@ class EtcdServer:
                         self.v2store.recovery(v2blob)
                 except (ValueError, KeyError):
                     pass  # pre-v2 snapshot format
-            self.raft_storage.set_hard_state(hs)
-            self.raft_storage.append(ents)
+            if self.cfg.raft_backend != "tpu":
+                self.raft_storage.set_hard_state(hs)
+                self.raft_storage.append(ents)
             # Raft replays ALL committed entries after the snapshot so
             # conf changes rebuild its config; the consistent-index
             # guard dedupes backend effects (server.go:1815-1827) —
@@ -337,6 +346,11 @@ class EtcdServer:
             self._applied_index = snap.metadata.index
         else:
             self.wal = WAL.create(self.wal_dir, metadata=self.id.to_bytes(8, "big"))
+
+        if self.cfg.raft_backend == "tpu":
+            self._boot_raft_tpu(old_wal, snap, hs, ents)
+            self.storage = ServerStorage(self.wal, self.snapshotter)
+            return
 
         raft_cfg = Config(
             id=self.id,
@@ -362,6 +376,70 @@ class EtcdServer:
             ]
             self.node = Node.start(raft_cfg, peers)
         self.storage = ServerStorage(self.wal, self.snapshotter)
+
+    def _boot_raft_tpu(self, old_wal: bool, snap: Snapshot, hs,
+                       ents: List[Entry]) -> None:
+        """Construct the batched device engine behind the same Node
+        contract — the server-side `--raft-backend=tpu` path at the
+        single raft-construction site (ref: etcdserver/bootstrap.go:
+        473-536 bootstrapRaft; SURVEY §7.6)."""
+        from ..batched.node import BatchedNode
+        from ..batched.rawnode import RowRestore
+
+        if self.cfg.join:
+            # The batched layout boots with the full voter mask; a
+            # joiner must come up voterless until its admitting conf
+            # change commits (Node.restart semantics) — not implemented
+            # on the device path, and silently granting votes before
+            # admission is the split-brain the flag prevents.
+            raise NotImplementedError(
+                "raft_backend='tpu' does not support join=True; "
+                "bootstrap the member in the initial cluster or use "
+                "the host backend")
+        if not old_wal:
+            # Fresh boot: the host path seeds the member registry via
+            # bootstrap ConfChange entries (Node.start); the batched
+            # engine boots with membership as initial state, so seed
+            # the registry directly with the same Member contexts.
+            for p in self.cfg.peers:
+                if self.cluster.member(p) is None:
+                    self.cluster.add_member(Member(id=p, name=f"m{p}"))
+
+        restore = None
+        if old_wal and hs is not None:
+            base = snap.metadata.index
+            restore = RowRestore(
+                term=hs.term,
+                vote=hs.vote,
+                commit=hs.commit,
+                applied=base,
+                snap_index=base,
+                snap_term=snap.metadata.term,
+                entries=[
+                    (e.index, e.term, e.data, int(e.type))
+                    for e in ents
+                    if e.index > base
+                ],
+                conf_state=(snap.metadata.conf_state
+                            if snap.metadata.index > 0 else None),
+            )
+        # Device ring must cover the un-snapshotted tail (snapshots
+        # every snapshot_count entries plus catch-up margin).
+        window = 1 << max(6, (2 * self.cfg.snapshot_count + 64).bit_length())
+        window = min(window, 1 << 15)
+        if self.cfg.snapshot_count > window // 4:
+            self.cfg.snapshot_count = window // 4
+            self.cfg.snapshot_catchup_entries = min(
+                self.cfg.snapshot_catchup_entries, window // 8)
+        self.node = BatchedNode(
+            node_id=self.id,
+            peers=self.cfg.peers,
+            election_tick=self.cfg.election_tick,
+            heartbeat_tick=self.cfg.heartbeat_tick,
+            window=window,
+            pre_vote=self.cfg.pre_vote,
+            restore=restore,
+        )
 
     # -- loops -----------------------------------------------------------------
 
@@ -434,10 +512,11 @@ class EtcdServer:
             failpoint.fp("raftAfterSave")
             if not is_empty_snap(rd.snapshot):
                 failpoint.fp("raftBeforeApplySnap")
-                self.raft_storage.apply_snapshot(rd.snapshot)
+                if self.cfg.raft_backend != "tpu":  # device holds the log
+                    self.raft_storage.apply_snapshot(rd.snapshot)
                 failpoint.fp("raftAfterApplySnap")
             persisted.set()
-            if rd.entries:
+            if rd.entries and self.cfg.raft_backend != "tpu":
                 self.raft_storage.append(rd.entries)
             if not islead:
                 failpoint.fp("raftBeforeFollowerSend")
@@ -666,6 +745,8 @@ class EtcdServer:
         self._snapshot()
 
     def _snapshot_index(self) -> int:
+        if self.cfg.raft_backend == "tpu":
+            return int(self.node.rn.m_snap[0])  # device ring floor
         try:
             return self.raft_storage.snapshot().metadata.index
         except Exception:  # noqa: BLE001
@@ -688,6 +769,21 @@ class EtcdServer:
             # v2 state survives log compaction and restarts.
             "v2": self.v2store.save(),
         }).encode()
+        if self.cfg.raft_backend == "tpu":
+            snap = self.node.create_snapshot(
+                self._applied_index, self.confstate, data
+            )
+            self.storage.save_snap(snap)
+            # Keep the catch-up margin below the ring floor so a
+            # slightly-lagging follower gets log entries, not a full
+            # state transfer (ref: server.go:80 CatchUpEntries; the
+            # attached app snapshot at applied still covers any floor).
+            compact_index = max(
+                1, self._applied_index - self.cfg.snapshot_catchup_entries
+            )
+            self.node.compact(compact_index, snap)
+            self.storage.release(snap)
+            return
         snap = self.raft_storage.create_snapshot(
             self._applied_index, self.confstate, data
         )
