@@ -745,6 +745,64 @@ def cmd_defrag(args, pr: Printer) -> int:
     return rc
 
 
+def cmd_check_datascale(args, pr: Printer) -> int:
+    """ref: etcdctl/ctlv3/command/check.go:297-440 check datascale —
+    storage cost of holding a workload's keys (the reference reads RSS
+    from /metrics; the backend db size is the in-repo analog)."""
+    loads = {
+        "s": 2000, "small": 2000,
+        "m": 20000, "medium": 20000,
+        "l": 200000, "large": 200000,
+        "xl": 600000, "xLarge": 600000,
+    }
+    limit = loads.get(args.load)
+    if limit is None:
+        print(f"unknown load option {args.load!r}")
+        return 2
+    prefix = args.prefix.encode()
+    c = _client(args)
+    try:
+        rr = c.get(prefix, range_end=_prefix_end(prefix), limit=1)
+        if rr.kvs:
+            print(f"prefix {args.prefix!r} has keys; delete them first")
+            return 1
+        size_before = c.status().get("db_size", 0)
+        import random as _rand
+
+        from ..pkg.report import Report
+
+        rep = Report()
+        val = b"x" * 512
+        print(f"Start data scale check for work load "
+              f"[{limit} key-value pairs, 1024 bytes per key-value].")
+        t0 = time.monotonic()
+        for _ in range(limit):
+            k = prefix + _rand.getrandbits(63).to_bytes(8, "big").hex().encode()
+            s = time.monotonic()
+            try:
+                c.put(k.ljust(len(prefix) + 512, b"0"), val)
+                rep.results(time.monotonic() - s)
+            except Exception as e:  # noqa: BLE001
+                rep.results(time.monotonic() - s, e)
+        dt = time.monotonic() - t0
+        size_after = c.status().get("db_size", 0)
+        dresp = c.delete(prefix, _prefix_end(prefix))
+        if args.auto_compact and dresp.header.revision > 1:
+            c.compact(dresp.header.revision, physical=True)
+        if args.auto_defrag:
+            c.defragment()
+        st = rep.stats()
+        used = max(0, size_after - size_before)
+        pct = st.percentiles_ms
+        verdict = "PASS:" if st.errors == 0 else f"FAIL: {st.errors} errors;"
+        print(f"{verdict} Put {limit} kvs in {dt:.2f}s ({st.qps:.1f}/s), "
+              f"p50 {pct.get('50', 0):.1f}ms, p99 {pct.get('99', 0):.1f}ms")
+        print(f"Approximate backend bytes used : {used / 1024 / 1024:.2f} MB")
+        return 0 if st.errors == 0 else 1
+    finally:
+        c.close()
+
+
 def cmd_check_perf(args, pr: Printer) -> int:
     """ref: etcdctl/ctlv3/command/check.go checkPerf."""
     loads = {"s": (50, 1), "m": (200, 10), "l": (500, 50)}
@@ -980,6 +1038,13 @@ def build_parser() -> argparse.ArgumentParser:
     x = csub.add_parser("perf")
     x.add_argument("--load", default="s", choices=["s", "m", "l"])
     x.add_argument("--duration", type=int, default=0)
+    x = csub.add_parser("datascale")
+    x.add_argument("--load", default="s")
+    x.add_argument("--prefix", default="/etcdctl-check-datascale/")
+    x.add_argument("--auto-compact", dest="auto_compact",
+                   action="store_true")
+    x.add_argument("--auto-defrag", dest="auto_defrag",
+                   action="store_true")
 
     sp = sub.add_parser("make-mirror")
     sp.add_argument("destination")
@@ -1013,10 +1078,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"API version: {ver.API_VERSION}")
         return 0
     if args.cmd == "check":
-        if getattr(args, "check_cmd", None) != "perf":
-            parser.parse_args(["check", "--help"])
-            return 2
-        return cmd_check_perf(args, Printer(args.write_out, args.hex))
+        ccmd = getattr(args, "check_cmd", None)
+        if ccmd == "perf":
+            return cmd_check_perf(args, Printer(args.write_out, args.hex))
+        if ccmd == "datascale":
+            return cmd_check_datascale(
+                args, Printer(args.write_out, args.hex))
+        parser.parse_args(["check", "--help"])
+        return 2
     pr = Printer(args.write_out, args.hex)
     try:
         return _DISPATCH[args.cmd](args, pr)

@@ -347,9 +347,9 @@ class EtcdServer:
         else:
             self.wal = WAL.create(self.wal_dir, metadata=self.id.to_bytes(8, "big"))
 
+        self.storage = ServerStorage(self.wal, self.snapshotter)
         if self.cfg.raft_backend == "tpu":
             self._boot_raft_tpu(old_wal, snap, hs, ents)
-            self.storage = ServerStorage(self.wal, self.snapshotter)
             return
 
         raft_cfg = Config(
@@ -375,7 +375,6 @@ class EtcdServer:
                 for p in self.cfg.peers
             ]
             self.node = Node.start(raft_cfg, peers)
-        self.storage = ServerStorage(self.wal, self.snapshotter)
 
     def _boot_raft_tpu(self, old_wal: bool, snap: Snapshot, hs,
                        ents: List[Entry]) -> None:
@@ -427,10 +426,11 @@ class EtcdServer:
         # every snapshot_count entries plus catch-up margin).
         window = 1 << max(6, (2 * self.cfg.snapshot_count + 64).bit_length())
         window = min(window, 1 << 15)
-        if self.cfg.snapshot_count > window // 4:
-            self.cfg.snapshot_count = window // 4
-            self.cfg.snapshot_catchup_entries = min(
-                self.cfg.snapshot_catchup_entries, window // 8)
+        self.cfg.snapshot_count = min(self.cfg.snapshot_count, window // 4)
+        # Unconditional: a catch-up margin wider than the ring would pin
+        # the floor and eventually stall proposals on ring headroom.
+        self.cfg.snapshot_catchup_entries = min(
+            self.cfg.snapshot_catchup_entries, window // 8)
         self.node = BatchedNode(
             node_id=self.id,
             peers=self.cfg.peers,
@@ -440,6 +440,12 @@ class EtcdServer:
             pre_vote=self.cfg.pre_vote,
             restore=restore,
         )
+        if restore is not None and not is_empty_snap(snap):
+            # Seed the node's app snapshot so lagging followers can be
+            # served immediately after restart (the host path restores
+            # it into MemoryStorage); the ring floor is already at the
+            # snapshot index, so this only attaches the app state.
+            self.node.compact(snap.metadata.index, snap)
 
     # -- loops -----------------------------------------------------------------
 

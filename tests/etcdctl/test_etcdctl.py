@@ -192,6 +192,17 @@ class TestLeaseMemberEndpoint:
         rc, out = run(member, "check", "perf", "--load", "s")
         assert rc == 0 and "PASS" in out
 
+    def test_check_datascale_small(self, member):
+        rc, out = run(member, "check", "datascale", "--load", "s",
+                      "--auto-compact")
+        assert rc == 0, out
+        assert "PASS" in out and "backend bytes used" in out
+        # The workload's keys were cleaned up afterwards.
+        rc, out = run(member, "get", "/etcdctl-check-datascale/",
+                      "--prefix", "--count-only")
+        assert rc == 0
+        assert out.strip().splitlines()[-1] == "0"
+
 
 class TestLockElect:
     def test_lock_prints_key(self, member):

@@ -1,0 +1,211 @@
+"""Check-quorum lease elections + snapshot restore scenario ports
+(ref: raft/raft_test.go:1783-1975 check-quorum block, :2737-2773
+TestRestore) against the single-group core."""
+
+from etcd_tpu.raft.raft import StateType
+from etcd_tpu.raft.types import (
+    ConfChange,
+    ConfChangeType,
+    ConfState,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+)
+
+from .test_paper import NONE, new_test_raft, new_test_storage
+from .test_scenarios import Network, hup
+
+
+def _cq_trio():
+    a = new_test_raft(1, 10, 1, new_test_storage([1, 2, 3]))
+    b = new_test_raft(2, 10, 1, new_test_storage([1, 2, 3]))
+    c = new_test_raft(3, 10, 1, new_test_storage([1, 2, 3]))
+    for r in (a, b, c):
+        r.check_quorum = True
+    return a, b, c
+
+
+def test_leader_superseding_with_check_quorum():
+    """A candidate inside the lease window is rejected until the voter's
+    election clock expires (ref: raft_test.go:1783-1824)."""
+    a, b, c = _cq_trio()
+    nt = Network(a, b, c)
+    b.randomized_election_timeout = b.election_timeout + 1
+
+    for _ in range(b.election_timeout):
+        b.tick()
+    nt.send(hup(1))
+
+    assert a.state == StateType.StateLeader
+    assert c.state == StateType.StateFollower
+
+    nt.send(hup(3))
+    # b rejects c's vote: its election clock hasn't expired.
+    assert c.state == StateType.StateCandidate
+
+    for _ in range(b.election_timeout):
+        b.tick()
+    nt.send(hup(3))
+    assert c.state == StateType.StateLeader
+
+
+def test_leader_election_with_check_quorum():
+    """ref: raft_test.go:1826-1871."""
+    a, b, c = _cq_trio()
+    nt = Network(a, b, c)
+    a.randomized_election_timeout = a.election_timeout + 1
+    b.randomized_election_timeout = b.election_timeout + 2
+
+    # Immediately after creation, votes are cast regardless of the
+    # election timeout.
+    nt.send(hup(1))
+    assert a.state == StateType.StateLeader
+    assert c.state == StateType.StateFollower
+
+    a.randomized_election_timeout = a.election_timeout + 1
+    b.randomized_election_timeout = b.election_timeout + 2
+    for _ in range(a.election_timeout):
+        a.tick()
+    for _ in range(b.election_timeout):
+        b.tick()
+    nt.send(hup(3))
+
+    assert a.state == StateType.StateFollower
+    assert c.state == StateType.StateLeader
+
+
+def test_free_stuck_candidate_with_check_quorum():
+    """A higher-term stuck candidate is freed when the leader steps
+    down on its disruptive response (ref: raft_test.go:1873-1944)."""
+    a, b, c = _cq_trio()
+    nt = Network(a, b, c)
+    b.randomized_election_timeout = b.election_timeout + 1
+
+    for _ in range(b.election_timeout):
+        b.tick()
+    nt.send(hup(1))
+
+    nt.isolate(1)
+    nt.send(hup(3))
+
+    assert b.state == StateType.StateFollower
+    assert c.state == StateType.StateCandidate
+    assert c.term == b.term + 1
+
+    nt.send(hup(3))
+    assert b.state == StateType.StateFollower
+    assert c.state == StateType.StateCandidate
+    assert c.term == b.term + 2
+
+    nt.recover()
+    nt.send(
+        Message(from_=1, to=3, type=MessageType.MsgHeartbeat, term=a.term)
+    )
+    # The stale heartbeat's stale-term response deposes the leader.
+    assert a.state == StateType.StateFollower
+    assert c.term == a.term
+
+    nt.send(hup(3))
+    assert c.state == StateType.StateLeader
+
+
+def test_non_promotable_voter_with_check_quorum():
+    """A non-promotable node never campaigns but still follows
+    (ref: raft_test.go:1946-1975)."""
+    a = new_test_raft(1, 10, 1, new_test_storage([1, 2]))
+    b = new_test_raft(2, 10, 1, new_test_storage([1]))
+    a.check_quorum = True
+    b.check_quorum = True
+
+    nt = Network(a, b)
+    b.randomized_election_timeout = b.election_timeout + 1
+    # Remove 2 again: the network harness rebuilt b's progress map.
+    b.apply_conf_change(
+        ConfChange(
+            type=ConfChangeType.ConfChangeRemoveNode, node_id=2
+        ).as_v2()
+    )
+    assert not b.promotable()
+
+    for _ in range(b.election_timeout):
+        b.tick()
+    nt.send(hup(1))
+
+    assert a.state == StateType.StateLeader
+    assert b.state == StateType.StateFollower
+    assert b.lead == 1
+
+
+def test_restore():
+    """ref: raft_test.go:2737-2773."""
+    s = Snapshot(
+        metadata=SnapshotMetadata(
+            index=11, term=11, conf_state=ConfState(voters=[1, 2, 3])
+        )
+    )
+    sm = new_test_raft(1, 10, 1, new_test_storage([1, 2]))
+    assert sm.restore(s)
+
+    assert sm.raft_log.last_index() == 11
+    assert sm.raft_log.term(11) == 11
+    assert sm.prs.voter_nodes() == [1, 2, 3]
+
+    assert not sm.restore(s)
+    # It should not campaign before actually applying data.
+    for _ in range(sm.randomized_election_timeout):
+        sm.tick()
+    assert sm.state == StateType.StateFollower
+
+
+def test_restore_with_learner():
+    """ref: raft_test.go:2776-2824."""
+    s = Snapshot(
+        metadata=SnapshotMetadata(
+            index=11, term=11,
+            conf_state=ConfState(voters=[1, 2], learners=[3]),
+        )
+    )
+    storage = new_test_storage([1, 2])
+    sm = new_test_raft(3, 10, 1, storage)
+    assert sm.restore(s)
+
+    assert sm.raft_log.last_index() == 11
+    assert sm.raft_log.term(11) == 11
+    assert sm.prs.voter_nodes() == [1, 2]
+    assert sm.prs.learner_nodes() == [3]
+    assert sm.is_learner
+    for vid in s.metadata.conf_state.voters:
+        assert not sm.prs.progress[vid].is_learner
+    for lid in s.metadata.conf_state.learners:
+        assert sm.prs.progress[lid].is_learner
+
+    assert not sm.restore(s)
+
+
+def test_restore_ignore_snapshot():
+    """Snapshots at-or-below commit only fast-forward commit
+    (ref: raft_test.go:2876-2905 TestRestoreIgnoreSnapshot)."""
+    from etcd_tpu.raft.types import Entry
+
+    storage = new_test_storage([1, 2])
+    sm = new_test_raft(1, 10, 1, storage)
+    ents = [Entry(term=1, index=i) for i in (1, 2, 3)]
+    sm.raft_log.append(ents)
+    sm.raft_log.commit_to(1)
+
+    commit = 1
+    s = Snapshot(
+        metadata=SnapshotMetadata(
+            index=commit, term=1, conf_state=ConfState(voters=[1, 2])
+        )
+    )
+    # Ignore snapshot at current commit.
+    assert not sm.restore(s)
+    assert sm.raft_log.committed == commit
+
+    # A snapshot below the log end but above commit fast-forwards
+    # commit without truncating.
+    s.metadata.index = commit + 1
+    assert not sm.restore(s)
+    assert sm.raft_log.committed == commit + 1

@@ -1,0 +1,445 @@
+"""Conf-change + leader-transfer scenario ports
+(ref: raft/raft_test.go:3144-3796 — TestNewLeaderPendingConfig through
+TestLeaderTransferSecondTransferToSameNode), against the single-group
+core on the same Network harness as test_scenarios.py."""
+
+import pytest
+
+from etcd_tpu.raft.errors import ProposalDroppedError
+from etcd_tpu.raft.raft import StateType
+from etcd_tpu.raft.types import (
+    ConfChange,
+    ConfChangeSingle,
+    ConfChangeType,
+    ConfChangeV2,
+    Entry,
+    EntryType,
+    Message,
+    MessageType,
+)
+
+from .test_paper import NONE, new_test_raft, new_test_storage, read_messages
+from .test_scenarios import Network, hup, prop
+
+
+def transfer(frm, to):
+    return Message(from_=frm, to=to, type=MessageType.MsgTransferLeader)
+
+
+def check_transfer_state(lead, wstate, wlead):
+    """ref: raft_test.go:3796-3806 checkLeaderTransferState."""
+    assert lead.state == wstate, (lead.state, wstate)
+    assert lead.lead == wlead, (lead.lead, wlead)
+    assert lead.lead_transferee == NONE
+
+
+# -- conf changes -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("add_entry,wpending", [(False, 0), (True, 1)])
+def test_new_leader_pending_config(add_entry, wpending):
+    """ref: raft_test.go:3144-3164."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1, 2]))
+    if add_entry:
+        assert r.append_entry([Entry()])
+    r.become_candidate()
+    r.become_leader()
+    assert r.pending_conf_index == wpending
+
+
+def test_add_node():
+    """ref: raft_test.go:3167-3176."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1]))
+    r.apply_conf_change(
+        ConfChange(node_id=2, type=ConfChangeType.ConfChangeAddNode).as_v2()
+    )
+    assert r.prs.voter_nodes() == [1, 2]
+
+
+def test_add_learner():
+    """Learner add / promote / demote cycles (ref: raft_test.go:3178-3219)."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1]))
+    r.apply_conf_change(
+        ConfChange(
+            node_id=2, type=ConfChangeType.ConfChangeAddLearnerNode
+        ).as_v2()
+    )
+    assert not r.is_learner
+    assert r.prs.learner_nodes() == [2]
+    assert r.prs.progress[2].is_learner
+
+    r.apply_conf_change(
+        ConfChange(node_id=2, type=ConfChangeType.ConfChangeAddNode).as_v2()
+    )
+    assert not r.prs.progress[2].is_learner
+
+    r.apply_conf_change(
+        ConfChange(
+            node_id=1, type=ConfChangeType.ConfChangeAddLearnerNode
+        ).as_v2()
+    )
+    assert r.prs.progress[1].is_learner
+    assert r.is_learner
+
+    r.apply_conf_change(
+        ConfChange(node_id=1, type=ConfChangeType.ConfChangeAddNode).as_v2()
+    )
+    assert not r.prs.progress[1].is_learner
+    assert not r.is_learner
+
+
+def test_add_node_check_quorum():
+    """Adding a node doesn't immediately depose the leader; silence
+    eventually does (ref: raft_test.go:3221-3253)."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1]))
+    r.check_quorum = True
+    r.become_candidate()
+    r.become_leader()
+
+    for _ in range(r.election_timeout - 1):
+        r.tick()
+
+    r.apply_conf_change(
+        ConfChange(node_id=2, type=ConfChangeType.ConfChangeAddNode).as_v2()
+    )
+    r.tick()
+    assert r.state == StateType.StateLeader
+
+    for _ in range(r.election_timeout):
+        r.tick()
+    assert r.state == StateType.StateFollower
+
+
+def test_remove_node():
+    """ref: raft_test.go:3255-3272."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1, 2]))
+    r.apply_conf_change(
+        ConfChange(
+            node_id=2, type=ConfChangeType.ConfChangeRemoveNode
+        ).as_v2()
+    )
+    assert r.prs.voter_nodes() == [1]
+
+    with pytest.raises(Exception):
+        r.apply_conf_change(
+            ConfChange(
+                node_id=1, type=ConfChangeType.ConfChangeRemoveNode
+            ).as_v2()
+        )
+
+
+@pytest.mark.parametrize(
+    "peers,wp",
+    [([1], True), ([1, 2, 3], True), ([], False), ([2, 3], False)],
+)
+def test_promotable(peers, wp):
+    """ref: raft_test.go:3296-3313."""
+    r = new_test_raft(1, 5, 1, new_test_storage(peers))
+    assert r.promotable() == wp
+
+
+@pytest.mark.parametrize("pre_vote", [False, True])
+def test_campaign_while_leader(pre_vote):
+    """ref: raft_test.go:3337-3368."""
+    import random
+
+    from etcd_tpu.raft import Config
+    from etcd_tpu.raft.raft import Raft
+
+    cfg = Config(
+        id=1, election_tick=5, heartbeat_tick=1,
+        storage=new_test_storage([1]), max_size_per_msg=1 << 62,
+        max_inflight_msgs=256, pre_vote=pre_vote, rand=random.Random(1),
+    )
+    r = Raft(cfg)
+    assert r.state == StateType.StateFollower
+    r.step(Message(from_=1, to=1, type=MessageType.MsgHup))
+    assert r.state == StateType.StateLeader
+    term = r.term
+    r.step(Message(from_=1, to=1, type=MessageType.MsgHup))
+    assert r.state == StateType.StateLeader
+    assert r.term == term
+
+
+def test_commit_after_remove_node():
+    """A conf change that shrinks the quorum lets pending commands
+    commit (ref: raft_test.go:3370-3433)."""
+    s = new_test_storage([1, 2])
+    r = new_test_raft(1, 5, 1, s)
+    r.become_candidate()
+    r.become_leader()
+
+    cc = ConfChange(type=ConfChangeType.ConfChangeRemoveNode, node_id=2)
+    r.step(
+        Message(
+            type=MessageType.MsgProp,
+            entries=[Entry(type=EntryType.EntryConfChange,
+                           data=cc.marshal())],
+        )
+    )
+
+    def next_ents():
+        ents = r.raft_log.next_ents()
+        s.append(r.raft_log.unstable_entries())
+        r.raft_log.stable_to(r.raft_log.last_index(), r.raft_log.last_term())
+        r.raft_log.applied_to(r.raft_log.committed)
+        return ents
+
+    assert next_ents() == []
+    cc_index = r.raft_log.last_index()
+
+    r.step(
+        Message(
+            type=MessageType.MsgProp,
+            entries=[Entry(type=EntryType.EntryNormal, data=b"hello")],
+        )
+    )
+
+    r.step(Message(type=MessageType.MsgAppResp, from_=2, index=cc_index))
+    ents = next_ents()
+    assert len(ents) == 2
+    assert ents[0].type == EntryType.EntryNormal and ents[0].data == b""
+    assert ents[1].type == EntryType.EntryConfChange
+
+    r.apply_conf_change(cc.as_v2())
+    ents = next_ents()
+    assert len(ents) == 1
+    assert ents[0].type == EntryType.EntryNormal
+    assert ents[0].data == b"hello"
+
+
+# -- leader transfer ----------------------------------------------------------
+
+
+def test_leader_transfer_to_up_to_date_node():
+    """ref: raft_test.go:3435-3461."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    lead = nt.peers[1]
+    assert lead.lead == 1
+
+    nt.send(transfer(2, 1))
+    check_transfer_state(lead, StateType.StateFollower, 2)
+
+    nt.send(prop(1, b""))
+    nt.send(transfer(1, 2))
+    check_transfer_state(lead, StateType.StateLeader, 1)
+
+
+def test_leader_transfer_to_up_to_date_node_from_follower():
+    """ref: raft_test.go:3463-3486 (transfer requests sent to the
+    follower, which forwards to the leader)."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    lead = nt.peers[1]
+
+    nt.send(transfer(2, 2))
+    check_transfer_state(lead, StateType.StateFollower, 2)
+
+    nt.send(prop(1, b""))
+    nt.send(transfer(1, 1))
+    check_transfer_state(lead, StateType.StateLeader, 1)
+
+
+def test_leader_transfer_with_check_quorum():
+    """ref: raft_test.go:3488-3521."""
+    nt = Network(None, None, None)
+    for i in (1, 2, 3):
+        r = nt.peers[i]
+        r.check_quorum = True
+        r.randomized_election_timeout = r.election_timeout + i
+
+    f = nt.peers[2]
+    for _ in range(f.election_timeout):
+        f.tick()
+
+    nt.send(hup(1))
+    lead = nt.peers[1]
+    assert lead.lead == 1
+
+    nt.send(transfer(2, 1))
+    check_transfer_state(lead, StateType.StateFollower, 2)
+
+    nt.send(prop(1, b""))
+    nt.send(transfer(1, 2))
+    check_transfer_state(lead, StateType.StateLeader, 1)
+
+
+def test_leader_transfer_to_slow_follower():
+    """ref: raft_test.go:3523-3541."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+
+    nt.isolate(3)
+    nt.send(prop(1, b""))
+
+    nt.recover()
+    lead = nt.peers[1]
+    assert lead.prs.progress[3].match == 1
+
+    nt.send(transfer(3, 1))
+    check_transfer_state(lead, StateType.StateFollower, 3)
+
+
+def test_leader_transfer_to_self():
+    """ref: raft_test.go:3589-3598."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    lead = nt.peers[1]
+    nt.send(transfer(1, 1))
+    check_transfer_state(lead, StateType.StateLeader, 1)
+
+
+def test_leader_transfer_to_non_existing_node():
+    """ref: raft_test.go:3600-3608."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    lead = nt.peers[1]
+    nt.send(transfer(4, 1))
+    check_transfer_state(lead, StateType.StateLeader, 1)
+
+
+def test_leader_transfer_timeout():
+    """A pending transfer to an unreachable node aborts after an
+    election timeout (ref: raft_test.go:3610-3635)."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    nt.isolate(3)
+    lead = nt.peers[1]
+
+    nt.send(transfer(3, 1))
+    assert lead.lead_transferee == 3
+    for _ in range(lead.heartbeat_timeout):
+        lead.tick()
+    assert lead.lead_transferee == 3
+    for _ in range(lead.election_timeout - lead.heartbeat_timeout):
+        lead.tick()
+    check_transfer_state(lead, StateType.StateLeader, 1)
+
+
+def test_leader_transfer_ignore_proposal():
+    """Proposals are dropped while a transfer is pending
+    (ref: raft_test.go:3637-3660)."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    nt.isolate(3)
+    lead = nt.peers[1]
+
+    nt.send(transfer(3, 1))
+    assert lead.lead_transferee == 3
+
+    nt.send(prop(1, b""))
+    with pytest.raises(ProposalDroppedError):
+        lead.step(
+            Message(from_=1, to=1, type=MessageType.MsgProp,
+                    entries=[Entry()])
+        )
+    assert lead.prs.progress[1].match == 1
+
+
+def test_leader_transfer_receive_higher_term_vote():
+    """ref: raft_test.go:3662-3679."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    nt.isolate(3)
+    lead = nt.peers[1]
+
+    nt.send(transfer(3, 1))
+    assert lead.lead_transferee == 3
+
+    nt.send(
+        Message(from_=2, to=2, type=MessageType.MsgHup, index=1, term=2)
+    )
+    check_transfer_state(lead, StateType.StateFollower, 2)
+
+
+def test_leader_transfer_remove_node():
+    """ref: raft_test.go:3681-3698."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    nt.ignore(MessageType.MsgTimeoutNow)
+    lead = nt.peers[1]
+
+    nt.send(transfer(3, 1))
+    assert lead.lead_transferee == 3
+
+    lead.apply_conf_change(
+        ConfChange(
+            node_id=3, type=ConfChangeType.ConfChangeRemoveNode
+        ).as_v2()
+    )
+    check_transfer_state(lead, StateType.StateLeader, 1)
+
+
+def test_leader_transfer_demote_node():
+    """Joint demotion of the transferee aborts the transfer
+    (ref: raft_test.go:3700-3731)."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    nt.ignore(MessageType.MsgTimeoutNow)
+    lead = nt.peers[1]
+
+    nt.send(transfer(3, 1))
+    assert lead.lead_transferee == 3
+
+    lead.apply_conf_change(
+        ConfChangeV2(
+            changes=[
+                ConfChangeSingle(
+                    type=ConfChangeType.ConfChangeRemoveNode, node_id=3
+                ),
+                ConfChangeSingle(
+                    type=ConfChangeType.ConfChangeAddLearnerNode, node_id=3
+                ),
+            ]
+        )
+    )
+    lead.apply_conf_change(ConfChangeV2())  # leave joint
+    check_transfer_state(lead, StateType.StateLeader, 1)
+
+
+def test_leader_transfer_back():
+    """ref: raft_test.go:3733-3752."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    nt.isolate(3)
+    lead = nt.peers[1]
+
+    nt.send(transfer(3, 1))
+    assert lead.lead_transferee == 3
+
+    nt.send(transfer(1, 1))
+    check_transfer_state(lead, StateType.StateLeader, 1)
+
+
+def test_leader_transfer_second_transfer_to_another_node():
+    """ref: raft_test.go:3754-3773."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    nt.isolate(3)
+    lead = nt.peers[1]
+
+    nt.send(transfer(3, 1))
+    assert lead.lead_transferee == 3
+
+    nt.send(transfer(2, 1))
+    check_transfer_state(lead, StateType.StateFollower, 2)
+
+
+def test_leader_transfer_second_transfer_to_same_node():
+    """A duplicate transfer request must not extend the abort timeout
+    (ref: raft_test.go:3775-3795)."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    nt.isolate(3)
+    lead = nt.peers[1]
+
+    nt.send(transfer(3, 1))
+    assert lead.lead_transferee == 3
+
+    for _ in range(lead.heartbeat_timeout):
+        lead.tick()
+    nt.send(transfer(3, 1))
+    for _ in range(lead.election_timeout - lead.heartbeat_timeout):
+        lead.tick()
+    check_transfer_state(lead, StateType.StateLeader, 1)

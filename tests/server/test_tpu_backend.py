@@ -92,10 +92,12 @@ class TestServerOnBatchedBackend:
                 except Exception:  # noqa: BLE001
                     lead = c.wait_leader()
                     lead.put(PutRequest(key=b"s%d" % i, value=b"w%d" % i))
-            wait_until(
-                lambda: int(c.leader().node.rn.m_snap[0]) > 0,
-                msg="leader device ring floor advances",
-            )
+            def floor_advanced():
+                lead = c.leader()  # None during transient re-elections
+                return lead is not None and int(lead.node.rn.m_snap[0]) > 0
+
+            wait_until(floor_advanced,
+                       msg="leader device ring floor advances")
             s = c.restart(victim)
             wait_until(
                 lambda: all(
@@ -105,6 +107,51 @@ class TestServerOnBatchedBackend:
                 ),
                 timeout=40.0,
                 msg="snapshot catch-up on the batched backend",
+            )
+            hash_check(c.alive())
+        finally:
+            c.close()
+
+    def test_restarted_member_serves_snapshot(self, tmp_path):
+        # A member that restarts after snapshotting must still serve
+        # lagging followers: the boot path seeds the node's app
+        # snapshot from the snap dir (regression: _app_snap was None
+        # after restart, dropping every outbound MsgSnap).
+        c = Cluster(str(tmp_path), n=3, raft_backend="tpu",
+                    snapshot_count=16, snapshot_catchup_entries=4,
+                    request_timeout=25.0)
+        try:
+            lead = c.wait_leader()
+            victim = c.followers()[0].id
+            c.kill(victim)
+            for i in range(40):
+                try:
+                    lead.put(PutRequest(key=b"r%d" % i, value=b"w%d" % i))
+                except Exception:  # noqa: BLE001 — starved host retry
+                    lead = c.wait_leader()
+                    lead.put(PutRequest(key=b"r%d" % i, value=b"w%d" % i))
+
+            def floor_advanced():
+                s = c.leader()
+                return s is not None and int(s.node.rn.m_snap[0]) > 0
+
+            wait_until(floor_advanced, msg="ring floor advances")
+            # Restart both survivors: whoever leads next serves the
+            # lagging member from its boot-seeded app snapshot.
+            for s in list(c.alive()):
+                sid = s.id
+                c.kill(sid)
+                c.restart(sid)
+            c.wait_leader()
+            s = c.restart(victim)
+            wait_until(
+                lambda: all(
+                    s.range(RangeRequest(key=b"r%d" % i,
+                                         serializable=True)).kvs
+                    for i in range(40)
+                ),
+                timeout=40.0,
+                msg="catch-up served by a restarted member",
             )
             hash_check(c.alive())
         finally:
