@@ -1,0 +1,222 @@
+"""Vote-from-any-state, term-gated commit, and ReadIndex scenario ports
+(ref: raft/raft_test.go:523-601 testVoteFromAnyState, :705-792
+single-node/term-gated commits, :2177-2229 TestReadOnlyOptionSafe,
+:2341-2424 TestReadOnlyForNewLeader)."""
+
+import random
+
+import pytest
+
+from etcd_tpu.raft import Config
+from etcd_tpu.raft.raft import Raft, StateType
+from etcd_tpu.raft.types import Entry, HardState, Message, MessageType
+
+from .test_paper import NONE, new_test_raft, new_test_storage, read_messages
+from .test_scenarios import Network, beat, hup, prop
+
+
+@pytest.mark.parametrize(
+    "vt", [MessageType.MsgVote, MessageType.MsgPreVote]
+)
+@pytest.mark.parametrize(
+    "st",
+    [
+        StateType.StateFollower,
+        StateType.StatePreCandidate,
+        StateType.StateCandidate,
+        StateType.StateLeader,
+    ],
+)
+def test_vote_from_any_state(vt, st):
+    """Any role grants an up-to-date higher-term (pre)vote; real votes
+    reset state+term, pre-votes change nothing
+    (ref: raft_test.go:531-601)."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1, 2, 3]))
+    r.term = 1
+    if st == StateType.StateFollower:
+        r.become_follower(r.term, 3)
+    elif st == StateType.StatePreCandidate:
+        r.become_pre_candidate()
+    elif st == StateType.StateCandidate:
+        r.become_candidate()
+    else:
+        r.become_candidate()
+        r.become_leader()
+
+    orig_term = r.term
+    orig_vote = r.vote
+    new_term = r.term + 1
+    r.step(
+        Message(
+            from_=2, to=1, type=vt, term=new_term, log_term=new_term,
+            index=42,
+        )
+    )
+    msgs = read_messages(r)
+    assert len(msgs) == 1, (vt, st, msgs)
+    resp = msgs[0]
+    want_resp = (
+        MessageType.MsgVoteResp
+        if vt == MessageType.MsgVote
+        else MessageType.MsgPreVoteResp
+    )
+    assert resp.type == want_resp
+    assert not resp.reject
+
+    if vt == MessageType.MsgVote:
+        assert r.state == StateType.StateFollower
+        assert r.term == new_term
+        assert r.vote == 2
+    else:
+        # In a pre-vote, nothing changes.
+        assert r.state == st
+        assert r.term == orig_term
+        assert r.vote == orig_vote
+
+
+def test_single_node_commit():
+    """ref: raft_test.go:705-715."""
+    nt = Network(None)
+    nt.send(hup(1))
+    nt.send(prop(1, b"some data"))
+    nt.send(prop(1, b"some data"))
+    assert nt.peers[1].raft_log.committed == 3
+
+
+def test_cannot_commit_without_new_term_entry():
+    """Entries from a previous term don't commit by counting replicas;
+    a new-term entry unlocks them (ref: raft_test.go:720-762)."""
+    nt = Network(None, None, None, None, None)
+    nt.send(hup(1))
+
+    nt.cut(1, 3)
+    nt.cut(1, 4)
+    nt.cut(1, 5)
+
+    nt.send(prop(1, b"some data"))
+    nt.send(prop(1, b"some data"))
+    assert nt.peers[1].raft_log.committed == 1
+
+    nt.recover()
+    nt.ignore(MessageType.MsgApp)  # block the ChangeTerm entry commit
+
+    nt.send(hup(2))
+    assert nt.peers[2].raft_log.committed == 1
+
+    nt.recover()
+    nt.send(beat(2))
+    nt.send(prop(2, b"some data"))
+    assert nt.peers[2].raft_log.committed == 5
+
+
+def test_commit_without_new_term_entry():
+    """The new leader's empty ChangeTerm entry commits the backlog
+    (ref: raft_test.go:764-792)."""
+    nt = Network(None, None, None, None, None)
+    nt.send(hup(1))
+
+    nt.cut(1, 3)
+    nt.cut(1, 4)
+    nt.cut(1, 5)
+
+    nt.send(prop(1, b"some data"))
+    nt.send(prop(1, b"some data"))
+    sm = nt.peers[1]
+    assert sm.raft_log.committed == 1
+
+    nt.recover()
+    nt.send(hup(2))
+    assert sm.raft_log.committed == 4
+
+
+def read_index(nid, ctx):
+    return Message(
+        from_=nid, to=nid, type=MessageType.MsgReadIndex,
+        entries=[Entry(data=ctx)],
+    )
+
+
+def test_read_only_option_safe():
+    """ReadIndex round-trips through leader and followers, confirmed by
+    heartbeat-ack quorum (ref: raft_test.go:2177-2229)."""
+    a = new_test_raft(1, 10, 1, new_test_storage([1, 2, 3]))
+    b = new_test_raft(2, 10, 1, new_test_storage([1, 2, 3]))
+    c = new_test_raft(3, 10, 1, new_test_storage([1, 2, 3]))
+    nt = Network(a, b, c)
+    b.randomized_election_timeout = b.election_timeout + 1
+    for _ in range(b.election_timeout):
+        b.tick()
+    nt.send(hup(1))
+    assert a.state == StateType.StateLeader
+
+    cases = [
+        (a, 10, 11, b"ctx1"),
+        (b, 10, 21, b"ctx2"),
+        (c, 10, 31, b"ctx3"),
+        (a, 10, 41, b"ctx4"),
+        (b, 10, 51, b"ctx5"),
+        (c, 10, 61, b"ctx6"),
+    ]
+    for i, (sm, proposals, wri, wctx) in enumerate(cases):
+        for _ in range(proposals):
+            nt.send(prop(1, b""))
+        nt.send(read_index(sm.id, wctx))
+
+        assert sm.read_states, i
+        rs = sm.read_states[0]
+        assert rs.index == wri, (i, rs.index, wri)
+        assert rs.request_ctx == wctx, i
+        sm.read_states = []
+
+
+def test_read_only_for_new_leader():
+    """A new leader postpones reads until it commits in its own term
+    (ref: raft_test.go:2341-2424)."""
+    node_configs = [
+        (1, 1, 1, 0),
+        (2, 2, 2, 2),
+        (3, 2, 2, 2),
+    ]
+    peers = []
+    for nid, committed, applied, compact_index in node_configs:
+        storage = new_test_storage([1, 2, 3])
+        storage.append([Entry(index=1, term=1), Entry(index=2, term=1)])
+        storage.set_hard_state(HardState(term=1, commit=committed))
+        if compact_index:
+            storage.compact(compact_index)
+        cfg = Config(
+            id=nid, election_tick=10, heartbeat_tick=1, storage=storage,
+            applied=applied, max_size_per_msg=1 << 62,
+            max_inflight_msgs=256, rand=random.Random(nid),
+        )
+        peers.append(Raft(cfg))
+    nt = Network(*peers)
+
+    # Forbid the new leader from committing at its term yet.
+    nt.ignore(MessageType.MsgApp)
+    nt.send(hup(1))
+    sm = nt.peers[1]
+    assert sm.state == StateType.StateLeader
+
+    wctx = b"ctx"
+    nt.send(read_index(1, wctx))
+    assert sm.read_states == []  # dropped: no commit in term yet
+
+    nt.recover()
+    # The queued heartbeats drain inside the same send as the proposal
+    # (the reference's network drains r.msgs during the pump), so the
+    # commit advances 1 -> 4 atomically and the postponed read binds to 4.
+    for _ in range(sm.heartbeat_timeout):
+        sm.tick()
+    nt.send(prop(1, b""))
+    assert sm.raft_log.committed == 4
+    assert sm.raft_log.term(sm.raft_log.committed) == sm.term
+
+    # The postponed read surfaces once the term entry committed.
+    assert len(sm.read_states) == 1
+    assert sm.read_states[0].index == 4
+    assert sm.read_states[0].request_ctx == wctx
+
+    nt.send(read_index(1, wctx))
+    assert len(sm.read_states) == 2
+    assert sm.read_states[1].index == 4
