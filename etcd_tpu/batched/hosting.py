@@ -457,6 +457,209 @@ class InProcRouter:
             self._isolated.discard(member_id)
 
 
+class TCPRouter:
+    """Real-network fabric for MultiRaftMembers: one listener per
+    member, one ordered stream per peer, frames carrying
+    ``u32 len | u32 group | message-codec bytes`` (the rafthttp
+    "message" codec with a group prefix — SURVEY §7.5's host-side
+    per-shard message routing). Reuses ``MultiRaftMember.deliver()``
+    exactly like InProcRouter; senders drop-don't-block (ref:
+    etcdserver/raft.go:108-111)."""
+
+    MAX_PENDING = 4096
+
+    def __init__(self, member: MultiRaftMember,
+                 bind: Tuple[str, int] = ("127.0.0.1", 0)) -> None:
+        import socket
+
+        from ..transport.codec import MAX_FRAME, decode_message, \
+            encode_message
+
+        self._socket = socket
+        self._enc, self._dec = encode_message, decode_message
+        self._max_frame = MAX_FRAME
+        self.member = member
+        member._send = self.send
+        self._stopped = threading.Event()
+        self._lock = threading.Lock()
+        # peer id -> (queue, sender thread); established lazily.
+        self._peers: Dict[int, "object"] = {}
+        self._addrs: Dict[int, Tuple[str, int]] = {}
+        self._conns: List["object"] = []  # accepted sockets, for stop()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(bind)
+        self._listener.listen(16)
+        self.addr: Tuple[str, int] = self._listener.getsockname()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def add_peer(self, peer_id: int, addr: Tuple[str, int]) -> None:
+        with self._lock:
+            self._addrs[peer_id] = addr
+
+    # -- outbound --------------------------------------------------------------
+
+    def send(self, _from_id: int,
+             batch: List[Tuple[int, Message]]) -> None:
+        import queue as _q  # stdlib; alias avoids shadowing below
+
+        # Resolve/create destination queues once per batch under one
+        # lock acquisition (send runs on every member round).
+        targets = {m.to for _g, m in batch}
+        queues: Dict[int, "_q.Queue"] = {}
+        with self._lock:
+            if self._stopped.is_set():
+                return
+            for to in targets:
+                ent = self._peers.get(to)
+                if ent is None:
+                    addr = self._addrs.get(to)
+                    if addr is None:
+                        continue
+                    q: "_q.Queue" = _q.Queue(maxsize=self.MAX_PENDING)
+                    t = threading.Thread(
+                        target=self._sender, args=(to, addr, q),
+                        daemon=True)
+                    self._peers[to] = (q, t)
+                    t.start()
+                    ent = self._peers[to]
+                queues[to] = ent[0]
+        for group, m in batch:
+            q2 = queues.get(m.to)
+            if q2 is None:
+                continue
+            try:
+                q2.put_nowait((group, m))
+            except _q.Full:  # drop, never block the round loop
+                pass
+
+    def _sender(self, peer_id: int, addr: Tuple[str, int], q) -> None:
+        sock = None
+        while not self._stopped.is_set():
+            item = q.get()
+            if item is None:
+                break
+            group, m = item
+            # encode_message returns a length-prefixed frame; strip its
+            # prefix — this framing carries its own total + group id.
+            payload = self._enc(m)[4:]
+            if len(payload) + 4 > self._max_frame:
+                # The receiver would kill the stream on an oversized
+                # frame and the resend would churn it forever; drop it
+                # here instead (the raft layer retries via snapshots).
+                continue
+            frame = (
+                struct.pack("<II", len(payload) + 4, group) + payload
+            )
+            for _attempt in (0, 1):
+                if sock is None:
+                    try:
+                        sock = self._socket.create_connection(
+                            addr, timeout=2.0)
+                        sock.setsockopt(
+                            self._socket.IPPROTO_TCP,
+                            self._socket.TCP_NODELAY, 1)
+                    except OSError:
+                        sock = None
+                        break  # drop; next message retries the dial
+                try:
+                    sock.sendall(frame)
+                    break
+                except OSError:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None  # reconnect once, else drop
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- inbound ---------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                if self._stopped.is_set():
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._recv_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _recv_loop(self, conn) -> None:
+        def read_exact(n: int) -> Optional[bytes]:
+            buf = b""
+            while len(buf) < n:
+                try:
+                    chunk = conn.recv(n - len(buf))
+                except OSError:
+                    return None
+                if not chunk:
+                    return None
+                buf += chunk
+            return buf
+
+        while not self._stopped.is_set():
+            hdr = read_exact(4)
+            if hdr is None:
+                break
+            (total,) = struct.unpack("<I", hdr)
+            if not 4 <= total <= self._max_frame:
+                break
+            body = read_exact(total)
+            if body is None:
+                break
+            (group,) = struct.unpack_from("<I", body)
+            try:
+                m = self._dec(body[4:])
+            except Exception:  # noqa: BLE001 — corrupt frame: drop conn
+                break
+            try:
+                self.member.deliver(group, m)
+            except Exception:  # noqa: BLE001 — lossy-net semantics
+                pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:  # after _stopped: send() cannot add peers now
+            peers = list(self._peers.values())
+            self._peers.clear()
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:  # unblock recv threads parked in recv()
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for q, t in peers:
+            try:
+                q.put_nowait(None)
+            except Exception:  # noqa: BLE001
+                pass
+        for _q2, t in peers:
+            t.join(timeout=2)
+
+
 class MultiRaftCluster:
     """Convenience harness: R members × G groups in one process."""
 

@@ -11,7 +11,7 @@ from etcd_tpu.raft import Config
 from etcd_tpu.raft.raft import Raft, StateType
 from etcd_tpu.raft.types import Entry, HardState, Message, MessageType
 
-from .test_paper import NONE, new_test_raft, new_test_storage, read_messages
+from .test_paper import new_test_raft, new_test_storage, read_messages
 from .test_scenarios import Network, beat, hup, prop
 
 
