@@ -1,0 +1,177 @@
+"""Learner behavior + leader cycling + mixed-version pre-vote migration
+ports (ref: raft/raft_test.go:324-410 learner block, :413-444
+testLeaderCycle, :4090-4226 newPreVoteMigrationCluster +
+TestPreVoteMigration*)."""
+
+import pytest
+
+from etcd_tpu.raft.raft import StateType
+from etcd_tpu.raft.types import ConfState, Message, MessageType
+
+from .test_paper import (
+    NONE,
+    new_test_raft,
+    new_test_storage,
+    read_messages,
+)
+from .test_scenarios import Network, beat, hup, prop
+
+
+def new_learner_storage(peers, learners):
+    s = new_test_storage(peers)
+    s._snapshot.metadata.conf_state = ConfState(
+        voters=list(peers), learners=list(learners)
+    )
+    return s
+
+
+def test_learner_election_timeout():
+    """A learner never campaigns on timeout (ref: raft_test.go:324-341)."""
+    n2 = new_test_raft(2, 10, 1, new_learner_storage([1], [2]))
+    n2.become_follower(1, NONE)
+    n2.randomized_election_timeout = n2.election_timeout
+    for _ in range(n2.election_timeout):
+        n2.tick()
+    assert n2.state == StateType.StateFollower
+
+
+def test_learner_promotion():
+    """A promoted learner can campaign and win
+    (ref: raft_test.go:344-410)."""
+    from etcd_tpu.raft.types import ConfChange, ConfChangeType
+
+    n1 = new_test_raft(1, 10, 1, new_learner_storage([1], [2]))
+    n2 = new_test_raft(2, 10, 1, new_learner_storage([1], [2]))
+    n1.become_follower(1, NONE)
+    n2.become_follower(1, NONE)
+    # Network's adopt path preserves the voter/learner split.
+    nt = Network(n1, n2)
+
+    assert n1.state != StateType.StateLeader
+    n1.randomized_election_timeout = n1.election_timeout
+    for _ in range(n1.election_timeout):
+        n1.tick()
+    assert n1.state == StateType.StateLeader
+    assert n2.state == StateType.StateFollower
+
+    nt.send(beat(1))
+
+    cc = ConfChange(node_id=2, type=ConfChangeType.ConfChangeAddNode).as_v2()
+    n1.apply_conf_change(cc)
+    n2.apply_conf_change(cc)
+    assert not n2.is_learner
+
+    n2.randomized_election_timeout = n2.election_timeout
+    for _ in range(n2.election_timeout):
+        n2.tick()
+    nt.send(beat(2))
+
+    assert n1.state == StateType.StateFollower
+    assert n2.state == StateType.StateLeader
+
+
+def test_learner_can_vote():
+    """A learner grants valid votes — its vote still counts toward the
+    voters' quorum decisions (ref: raft_test.go:380-410)."""
+    n2 = new_test_raft(2, 10, 1, new_learner_storage([1], [2]))
+    n2.become_follower(1, NONE)
+
+    n2.step(
+        Message(
+            from_=1, to=2, term=2, type=MessageType.MsgVote,
+            log_term=11, index=11,
+        )
+    )
+    msgs = read_messages(n2)
+    assert len(msgs) == 1
+    assert msgs[0].type == MessageType.MsgVoteResp
+    assert not msgs[0].reject
+
+
+@pytest.mark.parametrize("pre_vote", [False, True])
+def test_leader_cycle(pre_vote):
+    """Every node can campaign and win in turn — elections work from a
+    dirty slate (ref: raft_test.go:413-444)."""
+    cfg = (lambda c: setattr(c, "pre_vote", True)) if pre_vote else None
+    nt = Network(None, None, None, config=cfg)
+    for campaigner in (1, 2, 3):
+        nt.send(hup(campaigner))
+        for nid, sm in nt.peers.items():
+            if nid == campaigner:
+                assert sm.state == StateType.StateLeader, (pre_vote, nid)
+            else:
+                assert sm.state == StateType.StateFollower, (pre_vote, nid)
+
+
+def _prevote_migration_cluster():
+    """ref: raft_test.go:4090-4144 newPreVoteMigrationCluster — a
+    rolling-restart mixed cluster: n1/n2 run pre-vote, n3 does not."""
+    n1 = new_test_raft(1, 10, 1, new_test_storage([1, 2, 3]))
+    n2 = new_test_raft(2, 10, 1, new_test_storage([1, 2, 3]))
+    n3 = new_test_raft(3, 10, 1, new_test_storage([1, 2, 3]))
+    n1.become_follower(1, NONE)
+    n2.become_follower(1, NONE)
+    n3.become_follower(1, NONE)
+    n1.pre_vote = True
+    n2.pre_vote = True
+
+    nt = Network(n1, n2, n3)
+    nt.send(hup(1))
+
+    nt.isolate(3)
+    nt.send(prop(1, b"some data"))
+    nt.send(hup(3))
+    nt.send(hup(3))
+
+    assert n1.state == StateType.StateLeader
+    assert n2.state == StateType.StateFollower
+    assert n3.state == StateType.StateCandidate
+    assert (n1.term, n2.term, n3.term) == (2, 2, 4)
+
+    # Enable pre-vote on n3, then heal — the migration completed.
+    n3.pre_vote = True
+    nt.recover()
+    return nt
+
+
+def test_prevote_migration_can_complete_election():
+    """ref: raft_test.go:4146-4179."""
+    nt = _prevote_migration_cluster()
+    n2, n3 = nt.peers[2], nt.peers[3]
+
+    nt.isolate(1)
+
+    nt.send(hup(3))
+    nt.send(hup(2))
+
+    assert n2.state == StateType.StateFollower
+    assert n3.state == StateType.StatePreCandidate
+
+    nt.send(hup(3))
+    nt.send(hup(2))
+
+    assert n2.state == StateType.StateLeader or \
+        n3.state == StateType.StateFollower
+
+
+def test_prevote_migration_with_free_stuck_precandidate():
+    """ref: raft_test.go:4181-4226."""
+    nt = _prevote_migration_cluster()
+    n1, n2, n3 = nt.peers[1], nt.peers[2], nt.peers[3]
+
+    nt.send(hup(3))
+    assert n1.state == StateType.StateLeader
+    assert n2.state == StateType.StateFollower
+    assert n3.state == StateType.StatePreCandidate
+
+    nt.send(hup(3))
+    assert n1.state == StateType.StateLeader
+    assert n2.state == StateType.StateFollower
+    assert n3.state == StateType.StatePreCandidate
+
+    nt.send(
+        Message(from_=1, to=3, type=MessageType.MsgHeartbeat, term=n1.term)
+    )
+    # The stale-term response deposes the leader, freeing the stuck peer.
+    assert n1.state == StateType.StateFollower
+    assert n3.term == n1.term
