@@ -6,7 +6,13 @@ TestPreVoteMigration*)."""
 import pytest
 
 from etcd_tpu.raft.raft import StateType
-from etcd_tpu.raft.types import ConfState, Message, MessageType
+from etcd_tpu.raft.types import (
+    ConfChange,
+    ConfChangeType,
+    ConfState,
+    Message,
+    MessageType,
+)
 
 from .test_paper import (
     NONE,
@@ -38,8 +44,6 @@ def test_learner_election_timeout():
 def test_learner_promotion():
     """A promoted learner can campaign and win
     (ref: raft_test.go:344-410)."""
-    from etcd_tpu.raft.types import ConfChange, ConfChangeType
-
     n1 = new_test_raft(1, 10, 1, new_learner_storage([1], [2]))
     n2 = new_test_raft(2, 10, 1, new_learner_storage([1], [2]))
     n1.become_follower(1, NONE)

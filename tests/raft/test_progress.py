@@ -1,0 +1,160 @@
+"""Leader-side Progress state-machine ports (ref: raft/raft_test.go:
+58-170 TestProgressLeader/ResumeByHeartbeatResp/Paused/FlowControl,
+raft/tracker/inflights_test.go free-to semantics via the count+
+watermark behavior of our Inflights)."""
+
+import random
+
+from etcd_tpu.raft import Config
+from etcd_tpu.raft.raft import Raft, StateType
+from etcd_tpu.raft.tracker import Inflights, ProgressStateType
+from etcd_tpu.raft.types import Entry, Message, MessageType
+
+from .test_paper import new_test_raft, new_test_storage, read_messages
+
+
+def test_progress_leader():
+    """The leader's own progress tracks its appends optimistically
+    (ref: raft_test.go:58-76)."""
+    r = new_test_raft(1, 5, 1, new_test_storage([1, 2]))
+    r.become_candidate()
+    r.become_leader()
+    r.prs.progress[2].become_replicate()
+
+    prop = Message(
+        from_=1, to=1, type=MessageType.MsgProp,
+        entries=[Entry(data=b"foo")],
+    )
+    for i in range(5):
+        pr = r.prs.progress[r.id]
+        assert pr.state == ProgressStateType.StateReplicate
+        assert pr.match == i + 1
+        assert pr.next == pr.match + 1
+        r.step(prop)
+
+
+def test_progress_resume_by_heartbeat_resp():
+    """Heartbeat responses clear probe_sent (ref: raft_test.go:79-96)."""
+    r = new_test_raft(1, 5, 1, new_test_storage([1, 2]))
+    r.become_candidate()
+    r.become_leader()
+
+    r.prs.progress[2].probe_sent = True
+    r.step(Message(from_=1, to=1, type=MessageType.MsgBeat))
+    assert r.prs.progress[2].probe_sent
+
+    r.prs.progress[2].become_replicate()
+    r.step(Message(from_=2, to=1, type=MessageType.MsgHeartbeatResp))
+    assert not r.prs.progress[2].probe_sent
+
+
+def test_progress_paused():
+    """A probing peer gets one in-flight append (ref: raft_test.go:98-108)."""
+    r = new_test_raft(1, 5, 1, new_test_storage([1, 2]))
+    r.become_candidate()
+    r.become_leader()
+    for _ in range(3):
+        r.step(
+            Message(
+                from_=1, to=1, type=MessageType.MsgProp,
+                entries=[Entry(data=b"somedata")],
+            )
+        )
+    assert len(read_messages(r)) == 1
+
+
+def test_progress_flow_control():
+    """Probe sends one capped append; replicate streams within the
+    inflight/byte budget (ref: raft_test.go:110-170)."""
+    cfg = Config(
+        id=1, election_tick=5, heartbeat_tick=1,
+        storage=new_test_storage([1, 2]), max_size_per_msg=2048,
+        max_inflight_msgs=3, rand=random.Random(1),
+    )
+    r = Raft(cfg)
+    r.become_candidate()
+    r.become_leader()
+    read_messages(r)
+
+    r.prs.progress[2].become_probe()
+    blob = b"a" * 1000
+    for _ in range(10):
+        r.step(
+            Message(
+                from_=1, to=1, type=MessageType.MsgProp,
+                entries=[Entry(data=blob)],
+            )
+        )
+
+    # Probe state: one append carrying the empty election entry + the
+    # first proposal.
+    ms = read_messages(r)
+    assert len(ms) == 1 and ms[0].type == MessageType.MsgApp
+    assert len(ms[0].entries) == 2
+    assert len(ms[0].entries[0].data) == 0
+    assert len(ms[0].entries[1].data) == 1000
+
+    # Ack → replicate: stream up to max_inflight messages of
+    # max_size_per_msg bytes (2 blobs each).
+    r.step(
+        Message(
+            from_=2, to=1, type=MessageType.MsgAppResp,
+            index=ms[0].entries[1].index,
+        )
+    )
+    ms = read_messages(r)
+    assert len(ms) == 3
+    for m in ms:
+        assert m.type == MessageType.MsgApp
+        assert len(m.entries) == 2
+
+    # Ack all three → the remaining two messages (three entries).
+    r.step(
+        Message(
+            from_=2, to=1, type=MessageType.MsgAppResp,
+            index=ms[2].entries[1].index,
+        )
+    )
+    ms = read_messages(r)
+    assert len(ms) == 2
+    for m in ms:
+        assert m.type == MessageType.MsgApp
+    assert len(ms[0].entries) == 2
+    assert len(ms[1].entries) == 1
+
+
+def test_inflights_add_and_full():
+    """ref: tracker/inflights_test.go:22-99 (capacity + full)."""
+    ins = Inflights(size=10)
+    for i in range(5):
+        ins.add(i)
+    assert ins.count() == 5
+    assert not ins.full()
+    for i in range(5, 10):
+        ins.add(i)
+    assert ins.count() == 10
+    assert ins.full()
+
+
+def test_inflights_free_le():
+    """ref: tracker/inflights_test.go:101-168 FreeLE."""
+    ins = Inflights(size=10)
+    for i in range(10):
+        ins.add(i)
+    ins.free_le(4)
+    assert ins.count() == 5
+    assert not ins.full()
+    ins.free_le(8)
+    assert ins.count() == 1
+    ins.free_le(9)
+    assert ins.count() == 0
+
+
+def test_inflights_free_first_one():
+    """ref: tracker/inflights_test.go:170-187 FreeFirstOne."""
+    ins = Inflights(size=10)
+    for i in range(10):
+        ins.add(i)
+    ins.free_first_one()
+    assert ins.count() == 9
+    assert not ins.full()
