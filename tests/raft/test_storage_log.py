@@ -1,0 +1,345 @@
+"""MemoryStorage + RaftLog unit-test ports (ref: raft/storage_test.go:
+25-290, raft/log_test.go:24-470 — the term/entries/compact/append and
+find-conflict/up-to-date/maybe-append/commit cursor tables)."""
+
+import pytest
+
+from etcd_tpu.raft import MemoryStorage
+from etcd_tpu.raft.errors import (
+    CompactedError,
+    SnapOutOfDateError,
+    UnavailableError,
+)
+from etcd_tpu.raft.log import RaftLog
+from etcd_tpu.raft.types import (
+    ConfState,
+    Entry,
+    Snapshot,
+    SnapshotMetadata,
+)
+
+from .test_paper import NO_LIMIT
+
+
+def storage_with(ents):
+    s = MemoryStorage()
+    s.ents = [Entry(index=e[0], term=e[1]) for e in ents]
+    return s
+
+
+def et(ents):
+    return [(e.index, e.term) for e in ents]
+
+
+# -- MemoryStorage (storage_test.go) ------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "i,err,wterm",
+    [
+        (2, CompactedError, 0),
+        (3, None, 3),
+        (4, None, 4),
+        (5, None, 5),
+        (6, UnavailableError, 0),
+    ],
+)
+def test_storage_term(i, err, wterm):
+    s = storage_with([(3, 3), (4, 4), (5, 5)])
+    if err:
+        with pytest.raises(err):
+            s.term(i)
+    else:
+        assert s.term(i) == wterm
+
+
+def _sz(*idx_terms):
+    return sum(Entry(index=i, term=t).size() for i, t in idx_terms)
+
+
+@pytest.mark.parametrize(
+    "lo,hi,maxsize,err,wents",
+    [
+        (2, 6, NO_LIMIT, CompactedError, None),
+        (3, 4, NO_LIMIT, CompactedError, None),
+        (4, 5, NO_LIMIT, None, [(4, 4)]),
+        (4, 6, NO_LIMIT, None, [(4, 4), (5, 5)]),
+        (4, 7, NO_LIMIT, None, [(4, 4), (5, 5), (6, 6)]),
+        # even at maxsize 0, the first entry is returned
+        (4, 7, 0, None, [(4, 4)]),
+        (4, 7, _sz((4, 4), (5, 5)), None, [(4, 4), (5, 5)]),
+        (4, 7, _sz((4, 4), (5, 5)) + Entry(index=6, term=6).size() // 2,
+         None, [(4, 4), (5, 5)]),
+        (4, 7, _sz((4, 4), (5, 5), (6, 6)) - 1, None, [(4, 4), (5, 5)]),
+        (4, 7, _sz((4, 4), (5, 5), (6, 6)), None, [(4, 4), (5, 5), (6, 6)]),
+    ],
+)
+def test_storage_entries(lo, hi, maxsize, err, wents):
+    s = storage_with([(3, 3), (4, 4), (5, 5), (6, 6)])
+    if err:
+        with pytest.raises(err):
+            s.entries(lo, hi, maxsize)
+    else:
+        assert et(s.entries(lo, hi, maxsize)) == wents
+
+
+def test_storage_last_index():
+    s = storage_with([(3, 3), (4, 4), (5, 5)])
+    assert s.last_index() == 5
+    s.append([Entry(index=6, term=5)])
+    assert s.last_index() == 6
+
+
+def test_storage_first_index():
+    s = storage_with([(3, 3), (4, 4), (5, 5)])
+    assert s.first_index() == 4
+    s.compact(4)
+    assert s.first_index() == 5
+
+
+@pytest.mark.parametrize(
+    "i,err,windex,wterm,wlen",
+    [
+        (2, CompactedError, 3, 3, 3),
+        (3, CompactedError, 3, 3, 3),
+        (4, None, 4, 4, 2),
+        (5, None, 5, 5, 1),
+    ],
+)
+def test_storage_compact(i, err, windex, wterm, wlen):
+    s = storage_with([(3, 3), (4, 4), (5, 5)])
+    if err:
+        with pytest.raises(err):
+            s.compact(i)
+    else:
+        s.compact(i)
+    assert s.ents[0].index == windex
+    assert s.ents[0].term == wterm
+    assert len(s.ents) == wlen
+
+
+@pytest.mark.parametrize("i,windex,wterm", [(4, 4, 4), (5, 5, 5)])
+def test_storage_create_snapshot(i, windex, wterm):
+    cs = ConfState(voters=[1, 2, 3])
+    s = storage_with([(3, 3), (4, 4), (5, 5)])
+    snap = s.create_snapshot(i, cs, b"data")
+    assert snap.data == b"data"
+    assert snap.metadata.index == windex
+    assert snap.metadata.term == wterm
+    assert snap.metadata.conf_state.voters == [1, 2, 3]
+
+
+@pytest.mark.parametrize(
+    "entries,wents",
+    [
+        ([(1, 1), (2, 2)], [(3, 3), (4, 4), (5, 5)]),
+        ([(3, 3), (4, 4), (5, 5)], [(3, 3), (4, 4), (5, 5)]),
+        ([(3, 3), (4, 6), (5, 6)], [(3, 3), (4, 6), (5, 6)]),
+        ([(3, 3), (4, 4), (5, 5), (6, 5)],
+         [(3, 3), (4, 4), (5, 5), (6, 5)]),
+        # truncate incoming + existing, then append
+        ([(2, 3), (3, 3), (4, 5)], [(3, 3), (4, 5)]),
+        # truncate existing and append
+        ([(4, 5)], [(3, 3), (4, 5)]),
+        # direct append
+        ([(6, 5)], [(3, 3), (4, 4), (5, 5), (6, 5)]),
+    ],
+)
+def test_storage_append(entries, wents):
+    s = storage_with([(3, 3), (4, 4), (5, 5)])
+    s.append([Entry(index=i, term=t) for i, t in entries])
+    assert et(s.ents) == wents
+
+
+def test_storage_apply_snapshot():
+    cs = ConfState(voters=[1, 2, 3])
+    s = MemoryStorage()
+    s.apply_snapshot(
+        Snapshot(
+            data=b"data",
+            metadata=SnapshotMetadata(index=4, term=4, conf_state=cs),
+        )
+    )
+    with pytest.raises(SnapOutOfDateError):
+        s.apply_snapshot(
+            Snapshot(
+                data=b"data",
+                metadata=SnapshotMetadata(index=3, term=3, conf_state=cs),
+            )
+        )
+
+
+# -- RaftLog (log_test.go) ----------------------------------------------------
+
+
+def new_log(storage=None):
+    return RaftLog(storage if storage is not None else MemoryStorage())
+
+
+PREV3 = [Entry(index=1, term=1), Entry(index=2, term=2),
+         Entry(index=3, term=3)]
+
+
+@pytest.mark.parametrize(
+    "ents,wconflict",
+    [
+        ([], 0),
+        ([(1, 1), (2, 2), (3, 3)], 0),
+        ([(2, 2), (3, 3)], 0),
+        ([(3, 3)], 0),
+        ([(1, 1), (2, 2), (3, 3), (4, 4), (5, 4)], 4),
+        ([(2, 2), (3, 3), (4, 4), (5, 4)], 4),
+        ([(3, 3), (4, 4), (5, 4)], 4),
+        ([(4, 4), (5, 4)], 4),
+        ([(1, 4), (2, 4)], 1),
+        ([(2, 1), (3, 4), (4, 4)], 2),
+        ([(3, 1), (4, 2), (5, 4), (6, 4)], 3),
+    ],
+)
+def test_find_conflict(ents, wconflict):
+    """ref: log_test.go:24-56."""
+    lg = new_log()
+    lg.append(list(PREV3))
+    got = lg.find_conflict([Entry(index=i, term=t) for i, t in ents])
+    assert got == wconflict
+
+
+@pytest.mark.parametrize(
+    "di,term,wup",
+    [
+        (-1, 4, True), (0, 4, True), (1, 4, True),
+        (-1, 2, False), (0, 2, False), (1, 2, False),
+        (-1, 3, False), (0, 3, True), (1, 3, True),
+    ],
+)
+def test_is_up_to_date(di, term, wup):
+    """ref: log_test.go:58-88."""
+    lg = new_log()
+    lg.append(list(PREV3))
+    assert lg.is_up_to_date(lg.last_index() + di, term) == wup
+
+
+@pytest.mark.parametrize(
+    "ents,windex,wents,wunstable",
+    [
+        ([], 2, [(1, 1), (2, 2)], 3),
+        ([(3, 2)], 3, [(1, 1), (2, 2), (3, 2)], 3),
+        ([(1, 2)], 1, [(1, 2)], 1),
+        ([(2, 3), (3, 3)], 3, [(1, 1), (2, 3), (3, 3)], 2),
+    ],
+)
+def test_log_append(ents, windex, wents, wunstable):
+    """ref: log_test.go:89-144."""
+    storage = MemoryStorage()
+    storage.append([Entry(index=1, term=1), Entry(index=2, term=2)])
+    lg = new_log(storage)
+
+    index = lg.append([Entry(index=i, term=t) for i, t in ents])
+    assert index == windex
+    assert et(lg.slice(1, lg.last_index() + 1, NO_LIMIT)) == wents
+    assert lg.unstable.offset == wunstable
+
+
+def test_compaction_side_effects():
+    """ref: log_test.go:277-338."""
+    last_index, unstable_index = 1000, 750
+    storage = MemoryStorage()
+    for i in range(1, unstable_index + 1):
+        storage.append([Entry(term=i, index=i)])
+    lg = new_log(storage)
+    for i in range(unstable_index, last_index):
+        lg.append([Entry(term=i + 1, index=i + 1)])
+
+    assert lg.maybe_commit(last_index, last_index)
+    lg.applied_to(lg.committed)
+
+    offset = 500
+    storage.compact(offset)
+    assert lg.last_index() == last_index
+    for j in range(offset, lg.last_index() + 1):
+        assert lg.term(j) == j
+        assert lg.match_term(j, j)
+
+    unstable = lg.unstable_entries()
+    assert len(unstable) == 250
+    assert unstable[0].index == 751
+
+    prev = lg.last_index()
+    lg.append([Entry(index=prev + 1, term=prev + 1)])
+    assert lg.last_index() == prev + 1
+    assert len(lg.entries(lg.last_index(), NO_LIMIT)) == 1
+
+
+@pytest.mark.parametrize(
+    "applied,wents",
+    [
+        (0, [(4, 1), (5, 1)]),
+        (3, [(4, 1), (5, 1)]),
+        (4, [(5, 1)]),
+        (5, []),
+    ],
+)
+def test_next_ents(applied, wents):
+    """ref: log_test.go:373-405."""
+    storage = MemoryStorage()
+    storage.apply_snapshot(
+        Snapshot(metadata=SnapshotMetadata(term=1, index=3))
+    )
+    lg = new_log(storage)
+    lg.append([Entry(term=1, index=i) for i in (4, 5, 6)])
+    lg.maybe_commit(5, 1)
+    lg.applied_to(applied)
+    assert et(lg.next_ents()) == wents
+
+
+@pytest.mark.parametrize("unstable", [3, 1])
+def test_unstable_ents(unstable):
+    """ref: log_test.go:408-440."""
+    prev = [Entry(term=1, index=1), Entry(term=2, index=2)]
+    storage = MemoryStorage()
+    storage.append(prev[: unstable - 1])
+    lg = new_log(storage)
+    lg.append(prev[unstable - 1:])
+
+    ents = lg.unstable_entries()
+    if ents:
+        lg.stable_to(ents[-1].index, ents[-1].term)
+    assert et(ents) == et(prev[unstable - 1:])
+    assert lg.unstable.offset == prev[-1].index + 1
+
+
+@pytest.mark.parametrize(
+    "commit,wcommit,wpanic",
+    [
+        (3, 3, False),
+        (1, 2, False),  # never decrease
+        (4, 0, True),  # out of range
+    ],
+)
+def test_commit_to(commit, wcommit, wpanic):
+    """ref: log_test.go:441-471."""
+    lg = new_log()
+    lg.append(list(PREV3))
+    lg.committed = 2
+    if wpanic:
+        with pytest.raises(RuntimeError):  # logger.panicf's panic
+            lg.commit_to(commit)
+    else:
+        lg.commit_to(commit)
+        assert lg.committed == wcommit
+
+
+def test_log_restore():
+    """ref: log_test.go:580-603."""
+    index, term = 1000, 1000
+    storage = MemoryStorage()
+    storage.apply_snapshot(
+        Snapshot(metadata=SnapshotMetadata(index=index, term=term))
+    )
+    lg = new_log(storage)
+
+    assert lg.all_entries() == []
+    assert lg.first_index() == index + 1
+    assert lg.committed == index
+    assert lg.unstable.offset == index + 1
+    assert lg.term(index) == term
