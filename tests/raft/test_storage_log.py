@@ -18,7 +18,7 @@ from etcd_tpu.raft.types import (
     SnapshotMetadata,
 )
 
-from .test_paper import NO_LIMIT
+from etcd_tpu.raft.log import NO_LIMIT
 
 
 def storage_with(ents):
@@ -238,6 +238,68 @@ def test_log_append(ents, windex, wents, wunstable):
     assert index == windex
     assert et(lg.slice(1, lg.last_index() + 1, NO_LIMIT)) == wents
     assert lg.unstable.offset == wunstable
+
+
+LAST_I, LAST_T, COMMIT = 3, 3, 1
+
+
+@pytest.mark.parametrize(
+    "log_term,index,committed,ents,wlasti,wappend,wcommit,wpanic",
+    [
+        # not match: term differs / index out of bound
+        (LAST_T - 1, LAST_I, LAST_I, [(LAST_I + 1, 4)], 0, False, COMMIT,
+         False),
+        (LAST_T, LAST_I + 1, LAST_I, [(LAST_I + 2, 4)], 0, False, COMMIT,
+         False),
+        # match with the last existing entry
+        (LAST_T, LAST_I, LAST_I, [], LAST_I, True, LAST_I, False),
+        (LAST_T, LAST_I, LAST_I + 1, [], LAST_I, True, LAST_I, False),
+        (LAST_T, LAST_I, LAST_I - 1, [], LAST_I, True, LAST_I - 1, False),
+        (LAST_T, LAST_I, 0, [], LAST_I, True, COMMIT, False),
+        (0, 0, LAST_I, [], 0, True, COMMIT, False),
+        (LAST_T, LAST_I, LAST_I, [(LAST_I + 1, 4)], LAST_I + 1, True,
+         LAST_I, False),
+        (LAST_T, LAST_I, LAST_I + 1, [(LAST_I + 1, 4)], LAST_I + 1, True,
+         LAST_I + 1, False),
+        (LAST_T, LAST_I, LAST_I + 2, [(LAST_I + 1, 4)], LAST_I + 1, True,
+         LAST_I + 1, False),
+        (LAST_T, LAST_I, LAST_I + 2, [(LAST_I + 1, 4), (LAST_I + 2, 4)],
+         LAST_I + 2, True, LAST_I + 2, False),
+        # match with an entry in the middle
+        (LAST_T - 1, LAST_I - 1, LAST_I, [(LAST_I, 4)], LAST_I, True,
+         LAST_I, False),
+        (LAST_T - 2, LAST_I - 2, LAST_I, [(LAST_I - 1, 4)], LAST_I - 1,
+         True, LAST_I - 1, False),
+        # conflict with an existing COMMITTED entry panics
+        (LAST_T - 3, LAST_I - 3, LAST_I, [(LAST_I - 2, 4)], LAST_I - 2,
+         True, LAST_I - 2, True),
+        (LAST_T - 2, LAST_I - 2, LAST_I, [(LAST_I - 1, 4), (LAST_I, 4)],
+         LAST_I, True, LAST_I, False),
+    ],
+)
+def test_log_maybe_append(log_term, index, committed, ents, wlasti,
+                          wappend, wcommit, wpanic):
+    """The follower append path: conflict truncation, commit to
+    min(committed, lastnewi), panic on committed-entry conflicts
+    (ref: log_test.go:155-275)."""
+    lg = new_log()
+    lg.append(list(PREV3))
+    lg.committed = COMMIT
+    entries = [Entry(index=i, term=t) for i, t in ents]
+    if wpanic:
+        with pytest.raises(RuntimeError):
+            lg.maybe_append(index, log_term, committed, entries)
+        return
+    lasti, ok = lg.maybe_append(index, log_term, committed, entries)
+    assert (lasti if ok else 0) == wlasti
+    assert ok == wappend
+    assert lg.committed == wcommit
+    if ok and entries:
+        got = lg.slice(
+            lg.last_index() - len(entries) + 1, lg.last_index() + 1,
+            NO_LIMIT,
+        )
+        assert et(got) == ents
 
 
 def test_compaction_side_effects():

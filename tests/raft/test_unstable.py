@@ -1,0 +1,134 @@
+"""Unstable-log unit-test ports (ref: raft/log_unstable_test.go:24-448
+— first/last index, term lookup, stable_to watermarks, and
+truncate-and-append shapes). The Go (value, ok) returns map to our
+Optional[int] API."""
+
+import pytest
+
+from etcd_tpu.raft.log import Unstable
+from etcd_tpu.raft.logger import get_logger
+from etcd_tpu.raft.types import Entry, Snapshot, SnapshotMetadata
+
+
+def make_unstable(entries, offset, snap_index=None):
+    u = Unstable(get_logger())
+    u.entries = [Entry(index=i, term=t) for i, t in entries]
+    u.offset = offset
+    if snap_index is not None:
+        u.snapshot = Snapshot(
+            metadata=SnapshotMetadata(index=snap_index[0],
+                                      term=snap_index[1])
+        )
+    return u
+
+
+@pytest.mark.parametrize(
+    "entries,offset,snap,windex",
+    [
+        ([(5, 1)], 5, None, None),
+        ([], 0, None, None),
+        ([(5, 1)], 5, (4, 1), 5),
+        ([], 5, (4, 1), 5),
+    ],
+)
+def test_unstable_maybe_first_index(entries, offset, snap, windex):
+    """ref: log_unstable_test.go:24-68."""
+    u = make_unstable(entries, offset, snap)
+    assert u.maybe_first_index() == windex
+
+
+@pytest.mark.parametrize(
+    "entries,offset,snap,windex",
+    [
+        ([(5, 1)], 5, None, 5),
+        ([(5, 1)], 5, (4, 1), 5),
+        ([], 5, (4, 1), 4),
+        ([], 0, None, None),
+    ],
+)
+def test_unstable_maybe_last_index(entries, offset, snap, windex):
+    """ref: log_unstable_test.go:70-115."""
+    u = make_unstable(entries, offset, snap)
+    assert u.maybe_last_index() == windex
+
+
+@pytest.mark.parametrize(
+    "entries,offset,snap,index,wterm",
+    [
+        # term from entries
+        ([(5, 1)], 5, None, 5, 1),
+        ([(5, 1)], 5, None, 6, None),
+        ([(5, 1)], 5, None, 4, None),
+        ([(5, 1)], 5, (4, 1), 5, 1),
+        ([(5, 1)], 5, (4, 1), 6, None),
+        # term from snapshot
+        ([(5, 1)], 5, (4, 1), 4, 1),
+        ([(5, 1)], 5, (4, 1), 3, None),
+        ([], 5, (4, 1), 5, None),
+        ([], 5, (4, 1), 4, 1),
+        ([], 0, None, 5, None),
+    ],
+)
+def test_unstable_maybe_term(entries, offset, snap, index, wterm):
+    """ref: log_unstable_test.go:117-196."""
+    u = make_unstable(entries, offset, snap)
+    assert u.maybe_term(index) == wterm
+
+
+def test_unstable_restore():
+    """ref: log_unstable_test.go:198-217."""
+    u = make_unstable([(5, 1)], 5, (4, 1))
+    s = Snapshot(metadata=SnapshotMetadata(index=6, term=2))
+    u.restore(s)
+    assert u.offset == s.metadata.index + 1
+    assert u.entries == []
+    assert u.snapshot is s
+
+
+@pytest.mark.parametrize(
+    "entries,offset,snap,index,term,woffset,wlen",
+    [
+        ([], 0, None, 5, 1, 0, 0),
+        ([(5, 1)], 5, None, 5, 1, 6, 0),
+        ([(5, 1), (6, 1)], 5, None, 5, 1, 6, 1),
+        ([(6, 2)], 6, None, 6, 1, 6, 1),  # term mismatch
+        ([(5, 1)], 5, None, 4, 1, 5, 1),  # old entry
+        ([(5, 1)], 5, None, 4, 2, 5, 1),
+        ([(5, 1)], 5, (4, 1), 5, 1, 6, 0),
+        ([(5, 1), (6, 1)], 5, (4, 1), 5, 1, 6, 1),
+        ([(6, 2)], 6, (5, 1), 6, 1, 6, 1),
+        ([(5, 1)], 5, (4, 1), 4, 1, 5, 1),  # stable to snapshot
+        ([(5, 2)], 5, (4, 2), 4, 1, 5, 1),
+    ],
+)
+def test_unstable_stable_to(entries, offset, snap, index, term, woffset,
+                            wlen):
+    """ref: log_unstable_test.go:219-302."""
+    u = make_unstable(entries, offset, snap)
+    u.stable_to(index, term)
+    assert u.offset == woffset
+    assert len(u.entries) == wlen
+
+
+@pytest.mark.parametrize(
+    "entries,offset,toappend,woffset,wents",
+    [
+        # append to the end
+        ([(5, 1)], 5, [(6, 1), (7, 1)], 5, [(5, 1), (6, 1), (7, 1)]),
+        # replace the unstable entries
+        ([(5, 1)], 5, [(5, 2), (6, 2)], 5, [(5, 2), (6, 2)]),
+        ([(5, 1)], 5, [(4, 2), (5, 2), (6, 2)], 4,
+         [(4, 2), (5, 2), (6, 2)]),
+        # truncate the existing entries and append
+        ([(5, 1), (6, 1), (7, 1)], 5, [(6, 2)], 5, [(5, 1), (6, 2)]),
+        ([(5, 1), (6, 1), (7, 1)], 5, [(7, 2), (8, 2)], 5,
+         [(5, 1), (6, 1), (7, 2), (8, 2)]),
+    ],
+)
+def test_unstable_truncate_and_append(entries, offset, toappend, woffset,
+                                      wents):
+    """ref: log_unstable_test.go:304-360."""
+    u = make_unstable(entries, offset)
+    u.truncate_and_append([Entry(index=i, term=t) for i, t in toappend])
+    assert u.offset == woffset
+    assert [(e.index, e.term) for e in u.entries] == wents
