@@ -1,0 +1,169 @@
+"""RawNode/Node boot-contract ports (ref: raft/rawnode_test.go:764-838
+TestRawNodeRestart/FromSnapshot, raft/node_test.go:126-170 propose,
+:504-556 tick/stop, :650-740 restart cases), adapted to this package's
+poll-style async Node."""
+
+import random
+import time
+
+import pytest
+
+from etcd_tpu.raft import Config, MemoryStorage
+from etcd_tpu.raft.rawnode import RawNode
+from etcd_tpu.raft.node import Node
+from etcd_tpu.raft.types import (
+    ConfState,
+    Entry,
+    HardState,
+    Snapshot,
+    SnapshotMetadata,
+    is_empty_hard_state,
+)
+
+from etcd_tpu.raft.log import NO_LIMIT
+
+from .test_paper import new_test_storage
+
+
+def new_config(storage, id_=1):
+    return Config(
+        id=id_, election_tick=10, heartbeat_tick=1, storage=storage,
+        max_size_per_msg=NO_LIMIT, max_inflight_msgs=256,
+        rand=random.Random(1),
+    )
+
+
+def restart_storage():
+    storage = new_test_storage([1])
+    storage.set_hard_state(HardState(term=1, commit=1))
+    storage.append(
+        [Entry(term=1, index=1), Entry(term=1, index=2, data=b"foo")]
+    )
+    return storage
+
+
+def snapshot_storage():
+    s = MemoryStorage()
+    s.set_hard_state(HardState(term=1, commit=3))
+    s.apply_snapshot(
+        Snapshot(
+            metadata=SnapshotMetadata(
+                conf_state=ConfState(voters=[1, 2]), index=2, term=1
+            )
+        )
+    )
+    s.append([Entry(term=1, index=3, data=b"foo")])
+    return s
+
+
+def test_rawnode_restart():
+    """On restart the first Ready carries ONLY the committed entries up
+    to the stored commit — no HardState change, no sync
+    (ref: rawnode_test.go:764-793)."""
+    rn = RawNode(new_config(restart_storage()))
+    rd = rn.ready()
+    assert is_empty_hard_state(rd.hard_state)
+    assert [(e.index, e.data) for e in rd.committed_entries] == [(1, b"")]
+    assert not rd.must_sync
+    rn.advance(rd)
+    assert not rn.has_ready()
+
+
+def test_rawnode_restart_from_snapshot():
+    """ref: rawnode_test.go:795-831."""
+    rn = RawNode(new_config(snapshot_storage()))
+    rd = rn.ready()
+    assert is_empty_hard_state(rd.hard_state)
+    assert [(e.index, e.data) for e in rd.committed_entries] == \
+        [(3, b"foo")]
+    assert not rd.must_sync
+    rn.advance(rd)
+    assert not rn.has_ready()
+
+
+def test_node_tick():
+    """A tick advances the election clock exactly once
+    (ref: node_test.go:504-522)."""
+    n = Node.restart(new_config(new_test_storage([1])))
+    rn = n.rn
+    try:
+        elapsed = rn.raft.election_elapsed
+        n.tick()
+        deadline = time.monotonic() + 5
+        while rn.raft.election_elapsed != elapsed + 1:
+            assert time.monotonic() < deadline, "tick never processed"
+            time.sleep(0.01)
+    finally:
+        n.stop()
+
+
+def test_node_stop_idempotent():
+    """Stop blocks until the loop exits and is idempotent
+    (ref: node_test.go:525-556)."""
+    n = Node.restart(new_config(new_test_storage([1])))
+    status = n.status()
+    assert status is not None
+    n.stop()
+    n.stop()  # no effect
+
+
+def test_node_restart():
+    """ref: node_test.go:650-690 — the async wrapper surfaces the same
+    restart Ready."""
+    n = Node.restart(new_config(restart_storage()))
+    try:
+        rd = n.ready(timeout=5)
+        assert rd is not None
+        assert is_empty_hard_state(rd.hard_state)
+        assert [(e.index, e.data) for e in rd.committed_entries] == \
+            [(1, b"")]
+        assert not rd.must_sync
+        n.advance()
+        assert n.ready(timeout=0.05) is None
+    finally:
+        n.stop()
+
+
+def test_node_restart_from_snapshot():
+    """ref: node_test.go:692-740."""
+    n = Node.restart(new_config(snapshot_storage()))
+    try:
+        rd = n.ready(timeout=5)
+        assert rd is not None
+        assert is_empty_hard_state(rd.hard_state)
+        assert [(e.index, e.data) for e in rd.committed_entries] == \
+            [(3, b"foo")]
+        assert not rd.must_sync
+        n.advance()
+        assert n.ready(timeout=0.05) is None
+    finally:
+        n.stop()
+
+
+def test_node_propose():
+    """A proposal round-trips through the async wrapper into the log
+    (ref: node_test.go:126-170, single-voter shape)."""
+    storage = new_test_storage([1])
+    n = Node.restart(new_config(storage))
+    try:
+        n.campaign()
+        deadline = time.monotonic() + 5
+        proposed = False
+        while time.monotonic() < deadline:
+            rd = n.ready(timeout=0.5)
+            if rd is None:
+                continue
+            storage.append(rd.entries)
+            if not is_empty_hard_state(rd.hard_state):
+                storage.set_hard_state(rd.hard_state)
+            if not proposed and rd.committed_entries:
+                n.propose(b"somedata")
+                proposed = True
+            if any(e.data == b"somedata" for e in rd.committed_entries):
+                n.advance()
+                break
+            n.advance()
+        else:
+            pytest.fail("proposal never committed")
+    finally:
+        n.stop()
