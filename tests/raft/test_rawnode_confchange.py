@@ -1,0 +1,222 @@
+"""RawNode conf-change proposal port: V1/V2 simple and joint
+transitions with the exact resulting ConfStates, pendingConfIndex
+accounting, and manual/auto joint leave
+(ref: raft/rawnode_test.go:124-410 TestRawNodeProposeAndConfChange +
+TestRawNodeJointAutoLeave)."""
+
+import pytest
+
+from etcd_tpu.raft.log import NO_LIMIT
+from etcd_tpu.raft.rawnode import RawNode
+from etcd_tpu.raft.types import (
+    ConfChange,
+    ConfChangeSingle,
+    ConfChangeTransition,
+    ConfChangeType,
+    ConfChangeV2,
+    EntryType,
+    Message,
+    MessageType,
+)
+
+from .test_paper import new_test_storage
+from .test_rawnode_node import new_config
+
+ADD = ConfChangeType.ConfChangeAddNode
+ADD_LEARNER = ConfChangeType.ConfChangeAddLearnerNode
+EXPLICIT = ConfChangeTransition.ConfChangeTransitionJointExplicit
+IMPLICIT = ConfChangeTransition.ConfChangeTransitionJointImplicit
+
+
+def cs_tuple(cs):
+    return (
+        sorted(cs.voters),
+        sorted(cs.learners),
+        sorted(cs.voters_outgoing),
+        sorted(cs.learners_next),
+        bool(cs.auto_leave),
+    )
+
+
+CASES = [
+    # V1 config change.
+    (ConfChange(type=ADD, node_id=2),
+     ([1, 2], [], [], [], False), None),
+    # The same as a V2 change: no joint config.
+    (ConfChangeV2(changes=[ConfChangeSingle(type=ADD, node_id=2)]),
+     ([1, 2], [], [], [], False), None),
+    # Learner add.
+    (ConfChangeV2(changes=[ConfChangeSingle(type=ADD_LEARNER, node_id=2)]),
+     ([1], [2], [], [], False), None),
+    # Explicit joint consensus.
+    (ConfChangeV2(changes=[ConfChangeSingle(type=ADD_LEARNER, node_id=2)],
+                  transition=EXPLICIT),
+     ([1], [2], [1], [], False), ([1], [2], [], [], False)),
+    # Implicit joint (auto-leave).
+    (ConfChangeV2(changes=[ConfChangeSingle(type=ADD_LEARNER, node_id=2)],
+                  transition=IMPLICIT),
+     ([1], [2], [1], [], True), ([1], [2], [], [], False)),
+    # Add a voter and demote n1: joint + LearnersNext.
+    (ConfChangeV2(changes=[
+        ConfChangeSingle(type=ADD, node_id=2),
+        ConfChangeSingle(type=ADD_LEARNER, node_id=1),
+        ConfChangeSingle(type=ADD_LEARNER, node_id=3),
+    ]),
+     ([2], [3], [1], [1], True), ([2], [1, 3], [], [], False)),
+    # Ditto explicit.
+    (ConfChangeV2(changes=[
+        ConfChangeSingle(type=ADD, node_id=2),
+        ConfChangeSingle(type=ADD_LEARNER, node_id=1),
+        ConfChangeSingle(type=ADD_LEARNER, node_id=3),
+    ], transition=EXPLICIT),
+     ([2], [3], [1], [1], False), ([2], [1, 3], [], [], False)),
+    # Ditto implicit.
+    (ConfChangeV2(changes=[
+        ConfChangeSingle(type=ADD, node_id=2),
+        ConfChangeSingle(type=ADD_LEARNER, node_id=1),
+        ConfChangeSingle(type=ADD_LEARNER, node_id=3),
+    ], transition=IMPLICIT),
+     ([2], [3], [1], [1], True), ([2], [1, 3], [], [], False)),
+]
+
+
+@pytest.mark.parametrize("cc,exp,exp2", CASES)
+def test_rawnode_propose_and_conf_change(cc, exp, exp2):
+    s = new_test_storage([1])
+    rn = RawNode(new_config(s))
+
+    rn.campaign()
+    proposed = False
+    ccdata = b""
+    cs = None
+    for _ in range(50):
+        if cs is not None:
+            break
+        rd = rn.ready()
+        s.append(rd.entries)
+        for ent in rd.committed_entries:
+            applied = None
+            if ent.type == EntryType.EntryConfChange:
+                applied = ConfChange.unmarshal(ent.data)
+            elif ent.type == EntryType.EntryConfChangeV2:
+                applied = ConfChangeV2.unmarshal(ent.data)
+            if applied is not None:
+                cs = rn.apply_conf_change(applied)
+        rn.advance(rd)
+        # Once leader: propose a command and the ConfChange.
+        if not proposed and rd.soft_state is not None and \
+                rd.soft_state.lead == rn.raft.id:
+            rn.propose(b"somedata")
+            ccdata = cc.marshal()
+            rn.propose_conf_change(cc)
+            proposed = True
+    assert cs is not None, "conf change never applied"
+
+    # The stable log's last two entries are exactly what we proposed.
+    last_index = s.last_index()
+    entries = s.entries(last_index - 1, last_index + 1, NO_LIMIT)
+    assert len(entries) == 2
+    assert entries[0].data == b"somedata"
+    v1, is_v1 = cc.as_v1()
+    wtype = (EntryType.EntryConfChange if is_v1
+             else EntryType.EntryConfChangeV2)
+    assert entries[1].type == wtype
+    assert entries[1].data == ccdata
+
+    assert cs_tuple(cs) == exp
+
+    maybe_plus_one = 0
+    auto_leave, ok = cc.as_v2().enter_joint()
+    if ok and auto_leave:
+        maybe_plus_one = 1  # the auto-leave entry is appended (unstable)
+    assert rn.raft.pending_conf_index == last_index + maybe_plus_one
+
+    # Simple change: nothing more. Joint: leave automatically or
+    # propose the manual leave.
+    rd = rn.ready()
+    context = b""
+    if not exp[4]:  # not auto_leave
+        assert rd.entries == []
+        if exp2 is None:
+            return
+        context = b"manual"
+        rn.propose_conf_change(ConfChangeV2(context=context))
+        rd = rn.ready()
+
+    assert len(rd.entries) == 1
+    assert rd.entries[0].type == EntryType.EntryConfChangeV2
+    leave = ConfChangeV2.unmarshal(rd.entries[0].data)
+    assert leave.changes == []
+    assert leave.context == context
+
+    # Pretend the leave applied (a single node can't reach the joint
+    # quorum for real).
+    cs = rn.apply_conf_change(leave)
+    assert cs_tuple(cs) == exp2
+
+
+def test_rawnode_joint_auto_leave():
+    """Auto-leave fires even after leadership churn: the joint config
+    applies while the node is deposed, no leave is proposed as a
+    follower, and re-election triggers the auto-leave
+    (ref: rawnode_test.go:330-410 TestRawNodeJointAutoLeave)."""
+    cc = ConfChangeV2(
+        changes=[ConfChangeSingle(type=ADD_LEARNER, node_id=2)],
+        transition=IMPLICIT,
+    )
+    exp = ([1], [2], [1], [], True)
+    exp2 = ([1], [2], [], [], False)
+
+    s = new_test_storage([1])
+    rn = RawNode(new_config(s))
+    rn.campaign()
+    proposed = False
+    cs = None
+    for _ in range(50):
+        if cs is not None:
+            break
+        rd = rn.ready()
+        s.append(rd.entries)
+        for ent in rd.committed_entries:
+            if ent.type == EntryType.EntryConfChangeV2:
+                # Force a step-down right before applying (the Go
+                # original's heartbeat-resp-with-higher-term trick).
+                rn.step(
+                    Message(
+                        type=MessageType.MsgHeartbeatResp, from_=1,
+                        term=rn.raft.term + 1,
+                    )
+                )
+                cs = rn.apply_conf_change(ConfChangeV2.unmarshal(ent.data))
+        rn.advance(rd)
+        if not proposed and rd.soft_state is not None and \
+                rd.soft_state.lead == rn.raft.id:
+            rn.propose(b"somedata")
+            rn.propose_conf_change(cc)
+            proposed = True
+    assert cs is not None, "conf change never applied"
+    assert cs_tuple(cs) == exp
+    # Deposed before apply: no pending conf index survives the term.
+    assert rn.raft.pending_conf_index == 0
+
+    # As a follower it must NOT propose the leave.
+    rd = rn.ready_without_accept()
+    assert rd.entries == []
+
+    # Re-elected: the auto-leave entry appears once applied catches up.
+    rn.campaign()
+    rd = rn.ready()
+    s.append(rd.entries)
+    rn.advance(rd)
+    rd = rn.ready()
+    s.append(rd.entries)
+
+    assert len(rd.entries) == 1
+    assert rd.entries[0].type == EntryType.EntryConfChangeV2
+    leave = ConfChangeV2.unmarshal(rd.entries[0].data)
+    assert leave.changes == [] and leave.context == b""
+
+    # Pretend the leave applied (the joint quorum can't be reached by
+    # this single voter for real).
+    cs = rn.apply_conf_change(leave)
+    assert cs_tuple(cs) == exp2
