@@ -220,3 +220,45 @@ def test_rawnode_joint_auto_leave():
     # this single voter for real).
     cs = rn.apply_conf_change(leave)
     assert cs_tuple(cs) == exp2
+
+
+def test_rawnode_propose_add_duplicate_node():
+    """A duplicate add is a no-op that doesn't block later changes
+    (ref: rawnode_test.go:412-486 TestRawNodeProposeAddDuplicateNode)."""
+    s = new_test_storage([1])
+    rn = RawNode(new_config(s))
+    rd = rn.ready()
+    s.append(rd.entries)
+    rn.advance(rd)
+
+    rn.campaign()
+    for _ in range(50):
+        rd = rn.ready()
+        s.append(rd.entries)
+        lead = rd.soft_state.lead if rd.soft_state else 0
+        rn.advance(rd)
+        if lead == rn.raft.id:
+            break
+    else:
+        pytest.fail("never became leader")
+
+    def propose_and_apply(cc):
+        rn.propose_conf_change(cc)
+        rd = rn.ready()
+        s.append(rd.entries)
+        for ent in rd.committed_entries:
+            if ent.type == EntryType.EntryConfChange:
+                rn.apply_conf_change(ConfChange.unmarshal(ent.data))
+        rn.advance(rd)
+
+    cc1 = ConfChange(type=ADD, node_id=1)
+    propose_and_apply(cc1)
+    propose_and_apply(cc1)  # duplicate: applied as a no-op
+    cc2 = ConfChange(type=ADD, node_id=2)
+    propose_and_apply(cc2)
+
+    last_index = s.last_index()
+    entries = s.entries(last_index - 2, last_index + 1, NO_LIMIT)
+    assert len(entries) == 3
+    assert entries[0].data == cc1.marshal()
+    assert entries[2].data == cc2.marshal()
