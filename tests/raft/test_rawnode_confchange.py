@@ -261,4 +261,5 @@ def test_rawnode_propose_add_duplicate_node():
     entries = s.entries(last_index - 2, last_index + 1, NO_LIMIT)
     assert len(entries) == 3
     assert entries[0].data == cc1.marshal()
+    assert entries[1].data == cc1.marshal()  # the duplicate is logged
     assert entries[2].data == cc2.marshal()

@@ -1,0 +1,139 @@
+"""Leader-side snapshot provision + restore role-change ports
+(ref: raft/raft_test.go:2868-2914 restore voter/learner transitions,
+:2986-3110 TestProvideSnap/IgnoreProvidingSnap/RestoreFromSnapMsg/
+SlowNodeRestore)."""
+
+from etcd_tpu.raft.types import (
+    ConfState,
+    Entry,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+)
+
+from .test_learners_prevote import new_learner_storage
+from .test_paper import new_test_raft, new_test_storage, read_messages
+from .test_scenarios import Network, beat, hup, prop
+
+
+def snap_11(voters, learners=()):
+    return Snapshot(
+        metadata=SnapshotMetadata(
+            index=11, term=11,
+            conf_state=ConfState(voters=list(voters),
+                                 learners=list(learners)),
+        )
+    )
+
+
+def test_restore_voter_to_learner():
+    """A voter may be demoted to learner through a snapshot
+    (ref: raft_test.go:2868-2886)."""
+    sm = new_test_raft(3, 10, 1, new_test_storage([1, 2, 3]))
+    assert not sm.is_learner
+    assert sm.restore(snap_11([1, 2], learners=[3]))
+
+
+def test_restore_learner_promotion():
+    """A learner becomes a follower after restoring a promoting
+    snapshot (ref: raft_test.go:2888-2914)."""
+    sm = new_test_raft(3, 10, 1, new_learner_storage([1, 2], [3]))
+    assert sm.is_learner
+    assert sm.restore(snap_11([1, 2, 3]))
+    assert not sm.is_learner
+
+
+def test_provide_snap():
+    """A rejected probe below the compacted log yields a MsgSnap
+    (ref: raft_test.go:2986-3014)."""
+    storage = new_test_storage([1])
+    sm = new_test_raft(1, 10, 1, storage)
+    sm.restore(snap_11([1, 2]))
+    sm.become_candidate()
+    sm.become_leader()
+
+    sm.prs.progress[2].next = sm.raft_log.first_index()
+    sm.step(
+        Message(
+            from_=2, to=1, type=MessageType.MsgAppResp,
+            index=sm.prs.progress[2].next - 1, reject=True,
+        )
+    )
+
+    msgs = read_messages(sm)
+    assert len(msgs) == 1
+    assert msgs[0].type == MessageType.MsgSnap
+
+
+def test_ignore_providing_snap():
+    """No snapshot is sent to an inactive peer
+    (ref: raft_test.go:3016-3043)."""
+    storage = new_test_storage([1])
+    sm = new_test_raft(1, 10, 1, storage)
+    sm.restore(snap_11([1, 2]))
+    sm.become_candidate()
+    sm.become_leader()
+
+    sm.prs.progress[2].next = sm.raft_log.first_index() - 1
+    sm.prs.progress[2].recent_active = False
+
+    sm.step(
+        Message(
+            from_=1, to=1, type=MessageType.MsgProp,
+            entries=[Entry(data=b"somedata")],
+        )
+    )
+    assert read_messages(sm) == []
+
+
+def test_restore_from_snap_msg():
+    """MsgSnap installs leadership along with the snapshot
+    (ref: raft_test.go:3045-3063)."""
+    sm = new_test_raft(2, 10, 1, new_test_storage([1, 2]))
+    sm.step(
+        Message(
+            type=MessageType.MsgSnap, from_=1, term=2,
+            snapshot=snap_11([1, 2]),
+        )
+    )
+    assert sm.lead == 1
+
+
+def test_slow_node_restore():
+    """An isolated node catches up via snapshot once healed, then
+    tracks the commit index again (ref: raft_test.go:3065-3108)."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+
+    nt.isolate(3)
+    for _ in range(101):
+        nt.send(prop(1, b""))
+    lead = nt.peers[1]
+    # Stabilize + apply on the leader, then snapshot and compact.
+    storage = nt.storage[1]
+    storage.append(lead.raft_log.unstable_entries())
+    lead.raft_log.stable_to(
+        lead.raft_log.last_index(), lead.raft_log.last_term()
+    )
+    lead.raft_log.applied_to(lead.raft_log.committed)
+    storage.create_snapshot(
+        lead.raft_log.applied,
+        ConfState(voters=lead.prs.voter_nodes()),
+        b"",
+    )
+    storage.compact(lead.raft_log.applied)
+
+    nt.recover()
+    # Heartbeats until the leader learns node 3 is active again.
+    for _ in range(50):
+        nt.send(beat(1))
+        if lead.prs.progress[3].recent_active:
+            break
+    assert lead.prs.progress[3].recent_active
+
+    # Trigger the snapshot, then a commit on top of it.
+    nt.send(prop(1, b""))
+    follower = nt.peers[3]
+    nt.send(prop(1, b""))
+    assert follower.raft_log.committed == lead.raft_log.committed
