@@ -407,9 +407,12 @@ class MultiRaftMember:
         return self.kvs[group].data.get(key)
 
     def stop(self) -> None:
-        if self._stopped.is_set():
-            return
-        self._stopped.set()
+        # Atomic claim: concurrent stop() calls must not both proceed to
+        # the WAL close (Event.is_set/set is a check-then-act race).
+        with self._lock:
+            if self._stopped.is_set():
+                return
+            self._stopped.set()
         for t in (self._ticker, self._runner):
             if t.is_alive() and t is not threading.current_thread():
                 t.join(timeout=5)

@@ -2,12 +2,16 @@
 instance axis, with an all-device message router.
 
 Semantics mirror the single-group oracle (etcd_tpu.raft.raft, ref:
-raft/raft.go Step/stepLeader/stepCandidate/stepFollower) for the hot
-path: appends, append responses with reject-hint probing, heartbeats,
-elections (vote + optional pre-vote), commit-index advancement, snapshot
-fallback for lagging followers, and proposals. Cold-path features
-(joint-config membership changes, leader transfer, ReadIndex) run on the
-host via the oracle and are uploaded as new masks — see SURVEY.md §2.1.
+raft/raft.go Step/stepLeader/stepCandidate/stepFollower): appends,
+append responses with reject-hint probing, heartbeats, elections (vote +
+optional pre-vote), commit-index advancement, snapshot fallback for
+lagging followers, proposals — and, since round 2, the former cold
+paths as well: ReadIndex (heartbeat-ack quorum, see the readindex
+handling around the heartbeat-response lane below), joint-config
+membership changes (per-instance voter/learner masks with joint
+commit/vote kernels), learners, and leader transfer all execute on
+device. The host uploads mask/config rows (set_membership) but does not
+step the protocol for any of these — see SURVEY.md §2.1.
 
 Network model: per round each replica sends at most one message of each
 KIND to each peer, so an inbox is a dense ``[N, R, K]`` slot array and
