@@ -1,0 +1,237 @@
+"""raft_test.go long-tail ports: the snapshot-replication block and
+progress-state send gating (ref: raft/raft_test.go:2613-2736
+TestSendAppendForProgress{Probe,Replicate,Snapshot} /
+TestRecvMsgUnreachable, :2822-2866 TestRestoreWithVotersOutgoing,
+:2916-2950 TestLearnerReceiveSnapshot, :3543-3588
+TestLeaderTransferAfterSnapshot)."""
+
+from etcd_tpu.raft.raft import StateType
+from etcd_tpu.raft.rawnode import new_ready
+from etcd_tpu.raft.tracker import ProgressStateType
+from etcd_tpu.raft.types import (
+    ConfState,
+    Entry,
+    HardState,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+)
+
+from etcd_tpu.raft.raft import SoftState
+
+from .test_learners_prevote import new_learner_storage
+from .test_paper import new_test_raft, new_test_storage, read_messages
+from .test_scenarios import Network, beat, hup, prop
+
+
+def must_append_entry(r, *ents):
+    assert r.append_entry(list(ents)), "entry unexpectedly dropped"
+
+
+def test_send_append_for_progress_probe():
+    """ref: raft_test.go:2613-2679."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1, 2]))
+    r.become_candidate()
+    r.become_leader()
+    read_messages(r)
+    r.prs.progress[2].become_probe()
+
+    # Each round is a heartbeat.
+    for i in range(3):
+        if i == 0:
+            # Only one MsgApp goes out on the first loop; afterwards the
+            # follower is paused until a heartbeat response arrives.
+            must_append_entry(r, Entry(data=b"somedata"))
+            r.send_append(2)
+            msg = read_messages(r)
+            assert len(msg) == 1
+            assert msg[0].index == 0
+
+        assert r.prs.progress[2].probe_sent
+        for _ in range(10):
+            must_append_entry(r, Entry(data=b"somedata"))
+            r.send_append(2)
+            assert read_messages(r) == []
+
+        # Do a heartbeat.
+        for _ in range(r.heartbeat_timeout):
+            r.step(Message(from_=1, to=1, type=MessageType.MsgBeat))
+        assert r.prs.progress[2].probe_sent
+
+        # Consume the heartbeat.
+        msg = read_messages(r)
+        assert len(msg) == 1
+        assert msg[0].type == MessageType.MsgHeartbeat
+
+    # A heartbeat response allows another message to be sent.
+    r.step(Message(from_=2, to=1, type=MessageType.MsgHeartbeatResp))
+    msg = read_messages(r)
+    assert len(msg) == 1
+    assert msg[0].index == 0
+    assert r.prs.progress[2].probe_sent
+
+
+def test_send_append_for_progress_replicate():
+    """ref: raft_test.go:2680-2695."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1, 2]))
+    r.become_candidate()
+    r.become_leader()
+    read_messages(r)
+    r.prs.progress[2].become_replicate()
+
+    for _ in range(10):
+        must_append_entry(r, Entry(data=b"somedata"))
+        r.send_append(2)
+        assert len(read_messages(r)) == 1
+
+
+def test_send_append_for_progress_snapshot():
+    """ref: raft_test.go:2697-2712."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1, 2]))
+    r.become_candidate()
+    r.become_leader()
+    read_messages(r)
+    r.prs.progress[2].become_snapshot(10)
+
+    for _ in range(10):
+        must_append_entry(r, Entry(data=b"somedata"))
+        r.send_append(2)
+        assert read_messages(r) == []
+
+
+def test_recv_msg_unreachable():
+    """ref: raft_test.go:2714-2736."""
+    s = new_test_storage([1, 2])
+    s.append([Entry(term=1, index=1), Entry(term=1, index=2),
+              Entry(term=1, index=3)])
+    r = new_test_raft(1, 10, 1, s)
+    r.become_candidate()
+    r.become_leader()
+    read_messages(r)
+    # Set node 2 to state replicate.
+    r.prs.progress[2].match = 3
+    r.prs.progress[2].become_replicate()
+    r.prs.progress[2].optimistic_update(5)
+
+    r.step(Message(from_=2, to=1, type=MessageType.MsgUnreachable))
+
+    assert r.prs.progress[2].state == ProgressStateType.StateProbe
+    assert r.prs.progress[2].next == r.prs.progress[2].match + 1
+
+
+def test_restore_with_voters_outgoing():
+    """ref: raft_test.go:2822-2866 — restoring a joint-config snapshot
+    adopts the union of both voter halves."""
+    s = Snapshot(
+        metadata=SnapshotMetadata(
+            index=11, term=11,
+            conf_state=ConfState(voters=[2, 3, 4],
+                                 voters_outgoing=[1, 2, 3]),
+        )
+    )
+    storage = new_test_storage([1, 2])
+    sm = new_test_raft(1, 10, 1, storage)
+    assert sm.restore(s)
+    assert sm.raft_log.last_index() == s.metadata.index
+    assert sm.raft_log.term(s.metadata.index) == s.metadata.term
+    assert sm.prs.voter_nodes() == [1, 2, 3, 4]
+    # A second identical restore is a no-op.
+    assert not sm.restore(s)
+    # It should not campaign before actually applying data.
+    for _ in range(sm.randomized_election_timeout):
+        sm.tick()
+    assert sm.state == StateType.StateFollower
+
+
+def test_learner_receive_snapshot():
+    """ref: raft_test.go:2916-2950 — a learner catches up via the
+    leader's heartbeat-driven commit after restoring a snapshot."""
+    s = Snapshot(
+        metadata=SnapshotMetadata(
+            index=11, term=11,
+            conf_state=ConfState(voters=[1], learners=[2]),
+        )
+    )
+    store = new_learner_storage([1], [2])
+    n1 = new_test_raft(1, 10, 1, store)
+    n2 = new_test_raft(2, 10, 1, new_learner_storage([1], [2]))
+
+    n1.restore(s)
+    ready = new_ready(n1, SoftState(), HardState())
+    store.apply_snapshot(ready.snapshot)
+    n1.advance(ready)
+
+    # Force-set n1's applied index.
+    n1.raft_log.applied_to(n1.raft_log.committed)
+
+    nt = Network(n1, n2)
+    n1.randomized_election_timeout = n1.election_timeout
+    for _ in range(n1.election_timeout):
+        n1.tick()
+
+    nt.send(beat(1))
+    assert n2.raft_log.committed == n1.raft_log.committed
+
+
+def check_leader_transfer_state(r, state, lead):
+    """ref: raft_test.go checkLeaderTransferState."""
+    assert r.state == state and r.lead == lead, (
+        f"after transferring, node has state {r.state} lead {r.lead}, "
+        f"want state {state} lead {lead}"
+    )
+    assert r.lead_transferee == 0
+
+
+def test_leader_transfer_after_snapshot():
+    """ref: raft_test.go:3543-3588 — transferring to a follower that
+    needs a snapshot completes only after the snapshot applies and the
+    follower reports progress via MsgAppResp."""
+    nt = Network(None, None, None)
+    nt.send(hup(1))
+    nt.isolate(3)
+
+    nt.send(prop(1, b""))
+    lead = nt.peers[1]
+    # Drain committed entries into storage (nextEnts equivalent).
+    lead.raft_log.next_ents()
+    nt.storage[1].append(lead.raft_log.unstable_entries())
+    lead.raft_log.stable_to(lead.raft_log.last_index(),
+                            lead.raft_log.last_term())
+    lead.raft_log.applied_to(lead.raft_log.committed)
+    nt.storage[1].create_snapshot(
+        lead.raft_log.applied,
+        ConfState(voters=lead.prs.voter_nodes()),
+        b"",
+    )
+    nt.storage[1].compact(lead.raft_log.applied)
+
+    nt.recover()
+    assert lead.prs.progress[3].match == 1
+
+    filtered = []
+
+    # The snapshot must be applied before the MsgAppResp goes through.
+    def hook(m):
+        if (m.type != MessageType.MsgAppResp or m.from_ != 3 or m.reject):
+            return True
+        filtered.append(m)
+        return False
+
+    nt.msg_hook = hook
+    # Transfer leadership to 3 while it still lacks the snapshot.
+    nt.send(Message(from_=3, to=1, type=MessageType.MsgTransferLeader))
+    assert lead.state == StateType.StateLeader, (
+        "node 1 should still be leader as snapshot is not applied"
+    )
+    assert filtered, "follower should report snapshot progress automatically"
+
+    # Apply the snapshot and resume progress.
+    follower = nt.peers[3]
+    ready = new_ready(follower, SoftState(), HardState())
+    nt.storage[3].apply_snapshot(ready.snapshot)
+    follower.advance(ready)
+    nt.msg_hook = None
+    nt.send(filtered[0])
+
+    check_leader_transfer_state(lead, StateType.StateFollower, 3)

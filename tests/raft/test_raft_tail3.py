@@ -1,0 +1,432 @@
+"""raft_test.go long-tail ports, batch 3: conf-change gating,
+membership edge cases, pre-vote cluster scenarios, and fast log
+rejection (ref: raft/raft_test.go:3102-3141 TestStepConfig/
+TestStepIgnoreConfig, :3274-3295 TestRemoveLearner, :3315-3335
+TestRaftNodes, :3341-3360 TestPreCampaignWhileLeader, :3814-3824
+TestTransferNonMember, :3830-3921 TestNodeWithSmallerTermCanComplete-
+Election, :3925-4000 TestPreVoteWithSplitVote, :4002-4049
+TestPreVoteWithCheckQuorum, :4051-4090 TestLearnerCampaign, :4227-4317
+testConfChangeCheckBeforeCampaign V1+V2, :4319-4580
+TestFastLogRejection, :665-740 TestLearnerLogReplication, :451-523
+testLeaderElectionOverwriteNewerLogs)."""
+
+import random
+
+import pytest
+
+from etcd_tpu.raft import Config, MemoryStorage
+from etcd_tpu.raft.raft import Raft, StateType
+from etcd_tpu.raft.types import (
+    ConfChange,
+    ConfChangeType,
+    ConfState,
+    Entry,
+    EntryType,
+    HardState,
+    Message,
+    MessageType,
+)
+
+from .test_learners_prevote import new_learner_storage
+from .test_paper import NONE, new_test_raft, new_test_storage, read_messages
+from .test_scenarios import Network, NopStepper, beat, hup, prop
+
+NO_LIMIT = 1 << 62
+
+
+def test_step_config():
+    """ref: raft_test.go:3102-3116."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1, 2]))
+    r.become_candidate()
+    r.become_leader()
+    index = r.raft_log.last_index()
+    r.step(Message(from_=1, to=1, type=MessageType.MsgProp,
+                   entries=[Entry(type=EntryType.EntryConfChange)]))
+    assert r.raft_log.last_index() == index + 1
+    assert r.pending_conf_index == index + 1
+
+
+def test_step_ignore_config():
+    """ref: raft_test.go:3120-3141 — a second uncommitted conf change
+    is rewritten to an empty normal entry."""
+    r = new_test_raft(1, 10, 1, new_test_storage([1, 2]))
+    r.become_candidate()
+    r.become_leader()
+    r.step(Message(from_=1, to=1, type=MessageType.MsgProp,
+                   entries=[Entry(type=EntryType.EntryConfChange)]))
+    index = r.raft_log.last_index()
+    pending = r.pending_conf_index
+    r.step(Message(from_=1, to=1, type=MessageType.MsgProp,
+                   entries=[Entry(type=EntryType.EntryConfChange)]))
+    ents = r.raft_log.entries(index + 1, NO_LIMIT)
+    assert len(ents) == 1
+    assert ents[0].type == EntryType.EntryNormal
+    assert ents[0].term == 1 and ents[0].index == 3
+    assert not ents[0].data
+    assert r.pending_conf_index == pending
+
+
+def test_remove_learner():
+    """ref: raft_test.go:3274-3295."""
+    r = new_test_raft(1, 10, 1, new_learner_storage([1], [2]))
+    r.apply_conf_change(
+        ConfChange(node_id=2,
+                   type=ConfChangeType.ConfChangeRemoveNode).as_v2()
+    )
+    assert r.prs.voter_nodes() == [1]
+    assert r.prs.learner_nodes() == []
+
+    # Removing the remaining voter panics.
+    with pytest.raises(Exception):
+        r.apply_conf_change(
+            ConfChange(node_id=1,
+                       type=ConfChangeType.ConfChangeRemoveNode).as_v2()
+        )
+
+
+def test_raft_nodes():
+    """ref: raft_test.go:3315-3335 — voter lists come out sorted."""
+    for ids, wids in [([1, 2, 3], [1, 2, 3]), ([3, 2, 1], [1, 2, 3])]:
+        r = new_test_raft(1, 10, 1, new_test_storage(ids))
+        assert r.prs.voter_nodes() == wids
+
+
+def test_pre_campaign_while_leader():
+    """ref: raft_test.go:3341-3360 (pre-vote arm)."""
+    cfg = Config(
+        id=1, election_tick=5, heartbeat_tick=1,
+        storage=new_test_storage([1]),
+        max_size_per_msg=NO_LIMIT, max_inflight_msgs=256,
+        pre_vote=True, rand=random.Random(1),
+    )
+    r = Raft(cfg)
+    assert r.state == StateType.StateFollower
+    r.step(hup(1))
+    assert r.state == StateType.StateLeader
+    term = r.term
+    # A leader ignores further MsgHup without bumping its term.
+    r.step(hup(1))
+    assert r.state == StateType.StateLeader
+    assert r.term == term
+
+
+def test_transfer_non_member():
+    """ref: raft_test.go:3814-3824 — a non-member ignores
+    MsgTimeoutNow / vote responses."""
+    r = new_test_raft(1, 5, 1, new_test_storage([2, 3, 4]))
+    r.step(Message(from_=2, to=1, type=MessageType.MsgTimeoutNow))
+    r.step(Message(from_=2, to=1, type=MessageType.MsgVoteResp))
+    r.step(Message(from_=3, to=1, type=MessageType.MsgVoteResp))
+    assert r.state == StateType.StateFollower
+
+
+def test_node_with_smaller_term_can_complete_election():
+    """ref: raft_test.go:3830-3921."""
+    n1 = new_test_raft(1, 10, 1, new_test_storage([1, 2, 3]))
+    n2 = new_test_raft(2, 10, 1, new_test_storage([1, 2, 3]))
+    n3 = new_test_raft(3, 10, 1, new_test_storage([1, 2, 3]))
+    for n in (n1, n2, n3):
+        n.become_follower(1, NONE)
+        n.pre_vote = True
+
+    nt = Network(n1, n2, n3)
+    nt.cut(1, 3)
+    nt.cut(2, 3)
+
+    nt.send(hup(1))
+    assert n1.state == StateType.StateLeader
+    assert n2.state == StateType.StateFollower
+
+    nt.send(hup(3))
+    assert n3.state == StateType.StatePreCandidate
+
+    nt.send(hup(2))
+    assert (n1.term, n2.term, n3.term) == (3, 3, 1)
+    assert (n1.state, n2.state, n3.state) == (
+        StateType.StateFollower, StateType.StateLeader,
+        StateType.StatePreCandidate)
+
+    # Recover the network, then isolate the current leader (crash of b).
+    nt.recover()
+    nt.cut(2, 1)
+    nt.cut(2, 3)
+
+    nt.send(hup(3))
+    nt.send(hup(1))
+    assert (n1.state == StateType.StateLeader
+            or n3.state == StateType.StateLeader), "no leader"
+
+
+def test_pre_vote_with_split_vote():
+    """ref: raft_test.go:3925-4000."""
+    n1 = new_test_raft(1, 10, 1, new_test_storage([1, 2, 3]))
+    n2 = new_test_raft(2, 10, 1, new_test_storage([1, 2, 3]))
+    n3 = new_test_raft(3, 10, 1, new_test_storage([1, 2, 3]))
+    for n in (n1, n2, n3):
+        n.become_follower(1, NONE)
+        n.pre_vote = True
+    nt = Network(n1, n2, n3)
+    nt.send(hup(1))
+
+    # Leader down; followers split their votes.
+    nt.isolate(1)
+    nt.send(hup(2), hup(3))
+    assert (n2.term, n3.term) == (3, 3)
+    assert (n2.state, n3.state) == (
+        StateType.StateCandidate, StateType.StateCandidate)
+
+    # Node 2's election times out first; next round completes.
+    nt.send(hup(2))
+    assert (n2.term, n3.term) == (4, 4)
+    assert (n2.state, n3.state) == (
+        StateType.StateLeader, StateType.StateFollower)
+
+
+def test_pre_vote_with_check_quorum():
+    """ref: raft_test.go:4002-4049."""
+    n1 = new_test_raft(1, 10, 1, new_test_storage([1, 2, 3]))
+    n2 = new_test_raft(2, 10, 1, new_test_storage([1, 2, 3]))
+    n3 = new_test_raft(3, 10, 1, new_test_storage([1, 2, 3]))
+    for n in (n1, n2, n3):
+        n.become_follower(1, NONE)
+        n.pre_vote = True
+        n.check_quorum = True
+    nt = Network(n1, n2, n3)
+    nt.send(hup(1))
+    nt.isolate(1)
+    assert n1.state == StateType.StateLeader
+    assert n2.state == StateType.StateFollower
+    assert n3.state == StateType.StateFollower
+
+    # Node 2 ignores node 3's pre-vote (it has heard from the leader),
+    # but the pair can still elect once node 2 times out itself.
+    nt.send(hup(3))
+    nt.send(hup(2))
+    assert n2.state == StateType.StateLeader or \
+        n3.state == StateType.StateFollower, "no leader"
+
+
+def test_learner_campaign():
+    """ref: raft_test.go:4051-4090 — learners never campaign, even on
+    MsgTimeoutNow."""
+    n1 = new_test_raft(1, 10, 1, new_test_storage([1]))
+    n1.apply_conf_change(
+        ConfChange(node_id=2,
+                   type=ConfChangeType.ConfChangeAddLearnerNode).as_v2())
+    n2 = new_test_raft(2, 10, 1, new_test_storage([1]))
+    n2.apply_conf_change(
+        ConfChange(node_id=2,
+                   type=ConfChangeType.ConfChangeAddLearnerNode).as_v2())
+    nt = Network(n1, n2)
+    # Network() rebuilds membership from the adopted peers; re-assert
+    # the learner topology it was built with.
+    for n in (n1, n2):
+        n.prs.voters[0].discard(2)
+        n.prs.learners.add(2)
+        n.prs.progress[2].is_learner = True
+    n2.is_learner = True
+
+    nt.send(hup(2))
+    assert n2.is_learner
+    assert n2.state == StateType.StateFollower
+
+    nt.send(hup(1))
+    assert n1.state == StateType.StateLeader and n1.lead == 1
+
+    nt.send(Message(from_=1, to=2, type=MessageType.MsgTimeoutNow))
+    assert n2.state == StateType.StateFollower
+
+
+@pytest.mark.parametrize("v2", [False, True])
+def test_conf_change_check_before_campaign(v2):
+    """ref: raft_test.go:4227-4317 — an unapplied conf change blocks
+    campaigning and leadership transfer."""
+    nt = Network(None, None, None)
+    n1 = nt.peers[1]
+    n2 = nt.peers[2]
+    nt.send(hup(1))
+    assert n1.state == StateType.StateLeader
+
+    # Begin removing node 2.
+    cc = ConfChange(type=ConfChangeType.ConfChangeRemoveNode, node_id=2)
+    if v2:
+        ty, data = EntryType.EntryConfChangeV2, cc.as_v2().marshal()
+    else:
+        ty, data = EntryType.EntryConfChange, cc.marshal()
+    nt.send(Message(from_=1, to=1, type=MessageType.MsgProp,
+                    entries=[Entry(type=ty, data=data)]))
+
+    # Trigger campaign in node 2: still follower, the committed conf
+    # change is not applied yet.
+    for _ in range(n2.randomized_election_timeout):
+        n2.tick()
+    assert n2.state == StateType.StateFollower
+
+    # Leadership transfer to 2 is also refused.
+    nt.send(Message(from_=2, to=1, type=MessageType.MsgTransferLeader))
+    assert n1.state == StateType.StateLeader
+    assert n2.state == StateType.StateFollower
+
+    # Abort transfer leader.
+    for _ in range(n1.election_timeout):
+        n1.tick()
+
+    # Advance apply on node 2.
+    def next_ents(r, s):
+        ents = r.raft_log.next_ents()
+        s.append(r.raft_log.unstable_entries())
+        r.raft_log.stable_to(r.raft_log.last_index(),
+                             r.raft_log.last_term())
+        r.raft_log.applied_to(r.raft_log.committed)
+        return ents
+
+    next_ents(n2, nt.storage[2])
+
+    # Transfer leadership to 2 again; now it succeeds.
+    nt.send(Message(from_=2, to=1, type=MessageType.MsgTransferLeader))
+    assert n1.state == StateType.StateFollower
+    assert n2.state == StateType.StateLeader
+
+    next_ents(n1, nt.storage[1])
+    # Node 1 can campaign again once its conf change applies.
+    for _ in range(n1.randomized_election_timeout):
+        n1.tick()
+    assert n1.state == StateType.StateCandidate
+
+
+FAST_LOG_CASES = [
+    # (leader terms by index, follower terms by index,
+    #  reject_hint_term, reject_hint_index,
+    #  next_append_term, next_append_index)
+    ([1, 2, 2, 4, 4, 4, 4], [1, 2, 2, 3, 3, 3, 3, 3, 3, 3, 3], 3, 7, 2, 3),
+    ([1, 2, 2, 3, 4, 4, 4, 5], [1, 2, 2, 3, 3, 3, 3, 3, 3, 3, 3], 3, 8, 3, 4),
+    ([1, 1, 1, 1], [1, 2, 2, 4], 1, 1, 1, 1),
+    ([1, 1, 1, 1, 1, 1], [1, 2, 2, 4], 1, 1, 1, 1),
+    ([1, 1, 1, 1], [1, 2, 2, 4, 4, 4], 1, 1, 1, 1),
+    ([1, 1, 1, 4, 5], [1, 1, 1, 4], 4, 4, 4, 4),
+    ([2, 5, 5, 5, 5, 5, 5, 5, 5], [2, 4, 4, 4, 4, 4], 4, 6, 2, 1),
+    ([2, 2, 2, 2, 2], [2, 4, 4, 4, 4, 4, 4, 4], 2, 1, 2, 1),
+]
+
+
+@pytest.mark.parametrize("case", range(len(FAST_LOG_CASES)))
+def test_fast_log_rejection(case):
+    """ref: raft_test.go:4319-4580 — reject hints let the leader jump
+    straight to the conflict point."""
+    leader_terms, follower_terms, wrt, wri, wnt, wni = FAST_LOG_CASES[case]
+    s1 = MemoryStorage()
+    s1._snapshot.metadata.conf_state = ConfState(voters=[1, 2, 3])
+    s1.append([Entry(index=i + 1, term=t)
+               for i, t in enumerate(leader_terms)])
+    s2 = MemoryStorage()
+    s2._snapshot.metadata.conf_state = ConfState(voters=[1, 2, 3])
+    s2.append([Entry(index=i + 1, term=t)
+               for i, t in enumerate(follower_terms)])
+
+    n1 = new_test_raft(1, 10, 1, s1)
+    n2 = new_test_raft(2, 10, 1, s2)
+    n1.become_candidate()
+    n1.become_leader()
+
+    n2.step(Message(from_=1, to=1, type=MessageType.MsgHeartbeat))
+    msgs = read_messages(n2)
+    assert len(msgs) == 1 and msgs[0].type == MessageType.MsgHeartbeatResp
+    n1.step(msgs[0])
+
+    msgs = read_messages(n1)
+    assert len(msgs) == 1 and msgs[0].type == MessageType.MsgApp
+    n2.step(msgs[0])
+    msgs = read_messages(n2)
+    assert len(msgs) == 1 and msgs[0].type == MessageType.MsgAppResp
+    assert msgs[0].reject
+    assert msgs[0].log_term == wrt, f"hint term {msgs[0].log_term}"
+    assert msgs[0].reject_hint == wri, f"hint index {msgs[0].reject_hint}"
+
+    n1.step(msgs[0])
+    msgs = read_messages(n1)
+    assert msgs[0].log_term == wnt, f"append term {msgs[0].log_term}"
+    assert msgs[0].index == wni, f"append index {msgs[0].index}"
+
+
+def test_learner_log_replication():
+    """ref: raft_test.go:665-740 (first half) — a learner replicates
+    and commits with the leader."""
+    n1 = new_test_raft(1, 10, 1, new_learner_storage([1], [2]))
+    n2 = new_test_raft(2, 10, 1, new_learner_storage([1], [2]))
+    nt = Network(n1, n2)
+
+    n1.become_follower(1, NONE)
+    n2.become_follower(1, NONE)
+
+    n1.randomized_election_timeout = n1.election_timeout
+    for _ in range(n1.election_timeout):
+        n1.tick()
+
+    nt.send(beat(1))
+    assert n1.state == StateType.StateLeader
+    assert n2.is_learner
+
+    next_committed = n1.raft_log.committed + 1
+    nt.send(prop(1))
+    assert n1.raft_log.committed == next_committed
+    assert n2.raft_log.committed == n1.raft_log.committed
+    match = n1.prs.progress[2].match
+    assert match == n2.raft_log.committed
+
+
+@pytest.mark.parametrize("pre_vote", [False, True])
+def test_leader_election_overwrite_newer_logs(pre_vote):
+    """ref: raft_test.go:451-523 — the election winner's log entry
+    overwrites the losers' newer-term entries."""
+    cfg = (lambda c: setattr(c, "pre_vote", True)) if pre_vote else None
+
+    def ents(*terms):
+        s = MemoryStorage()
+        s.append([Entry(index=i + 1, term=t) for i, t in enumerate(terms)])
+        c = Config(id=1, election_tick=5, heartbeat_tick=1, storage=s,
+                   max_size_per_msg=NO_LIMIT, max_inflight_msgs=256,
+                   rand=random.Random(1))
+        if cfg:
+            cfg(c)
+        r = Raft(c)
+        r.reset(terms[-1])
+        return r
+
+    def voted(vote, term):
+        s = MemoryStorage()
+        s.set_hard_state(HardState(vote=vote, term=term))
+        c = Config(id=1, election_tick=5, heartbeat_tick=1, storage=s,
+                   max_size_per_msg=NO_LIMIT, max_inflight_msgs=256,
+                   rand=random.Random(1))
+        if cfg:
+            cfg(c)
+        r = Raft(c)
+        r.reset(term)
+        return r
+
+    n = Network(
+        ents(1),        # Node 1: won the first election
+        ents(1),        # Node 2: got logs from node 1
+        ents(2),        # Node 3: won the second election
+        voted(3, 2),    # Node 4: voted but didn't get logs
+        voted(3, 2),    # Node 5: voted but didn't get logs
+        config=cfg,
+    )
+
+    # Node 1's first campaign fails; its term is pushed to 2.
+    n.send(hup(1))
+    sm1 = n.peers[1]
+    assert sm1.state == StateType.StateFollower
+    assert sm1.term == 2
+
+    # Second campaign succeeds at term 3.
+    n.send(hup(1))
+    assert sm1.state == StateType.StateLeader
+    assert sm1.term == 3
+
+    # All nodes agree: term 1 at index 1, term 3 at index 2.
+    for i, p in n.peers.items():
+        entries = p.raft_log.all_entries()
+        assert len(entries) == 2, f"node {i}"
+        assert entries[0].term == 1, f"node {i}"
+        assert entries[1].term == 3, f"node {i}"

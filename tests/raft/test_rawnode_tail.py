@@ -1,0 +1,342 @@
+"""RawNode/Node contract long-tail ports
+(ref: raft/rawnode_test.go:74-104 TestRawNodeStep, :658-763
+TestRawNodeStart, :836-865 TestRawNodeStatus, :882-948
+TestRawNodeCommitPaginationAfterRestart, :950-1035
+TestRawNodeBoundedLogGrowthWithPartition, :1075-1110
+TestRawNodeConsumeReady; raft/node_test.go:46-77 TestNodeStep,
+:558-576 TestReadyContainUpdates, :582-650 TestNodeStart, :742-777
+TestNodeAdvance, :779-793 TestSoftStateEqual, :795-811
+TestIsHardStateEqual), adapted where noted to this package's
+poll-style async Node."""
+
+import time
+
+import pytest
+
+from etcd_tpu.raft import Config, MemoryStorage
+from etcd_tpu.raft.errors import (
+    ProposalDroppedError,
+    StepLocalMsgError,
+    StepPeerNotFoundError,
+)
+from etcd_tpu.raft.node import Node, Peer
+from etcd_tpu.raft.raft import SoftState, StateType, is_local_msg
+from etcd_tpu.raft.rawnode import RawNode, Ready
+from etcd_tpu.raft.types import (
+    ConfState,
+    Entry,
+    EntryType,
+    HardState,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+    is_empty_hard_state,
+)
+
+from .test_paper import new_test_storage
+from .test_rawnode_node import new_config
+
+
+def test_rawnode_step():
+    """ref: rawnode_test.go:74-104 — local messages are ignored by
+    RawNode.step; non-local ones are processed without blowing up."""
+    for msgt in MessageType:
+        s = MemoryStorage()
+        s.set_hard_state(HardState(term=1, commit=1))
+        s.append([Entry(term=1, index=1)])
+        s.apply_snapshot(Snapshot(metadata=SnapshotMetadata(
+            conf_state=ConfState(voters=[1]), index=1, term=1)))
+        rn = RawNode(new_config(s))
+        if is_local_msg(msgt):
+            # ErrStepLocalMsg analog: local messages are refused.
+            with pytest.raises(StepLocalMsgError):
+                rn.step(Message(type=msgt))
+        else:
+            try:
+                rn.step(Message(type=msgt))
+            except (ProposalDroppedError, StepPeerNotFoundError):
+                # MsgProp with no leader / response from unknown peer
+                # (the Go test ignores non-local step errors too).
+                pass
+
+
+def test_rawnode_start():
+    """ref: rawnode_test.go:658-763 — bootstrap via snapshot at index
+    1, then campaign+propose produce exactly one Ready."""
+    storage = MemoryStorage()
+    storage.ents[0].index = 1
+
+    # CockroachDB-style bootstrap: persist the ConfState in a snapshot
+    # at index 1 so empty followers must pick it up via snapshot.
+    assert storage.first_index() >= 2
+    storage.apply_snapshot(Snapshot(metadata=SnapshotMetadata(
+        index=1, term=0, conf_state=ConfState(voters=[1]))))
+
+    rn = RawNode(new_config(storage))
+    assert not rn.has_ready()
+    rn.campaign()
+    rn.propose(b"foo")
+    assert rn.has_ready()
+    rd = rn.ready()
+    storage.append(rd.entries)
+    rn.advance(rd)
+
+    assert rd.hard_state == HardState(term=1, commit=3, vote=1)
+    assert [(e.term, e.index, bytes(e.data)) for e in rd.entries] == [
+        (1, 2, b""), (1, 3, b"foo")]
+    assert rd.entries == rd.committed_entries
+    assert rd.must_sync
+    assert not rn.has_ready()
+
+
+def test_rawnode_status():
+    """ref: rawnode_test.go:836-865."""
+    s = new_test_storage([1])
+    rn = RawNode(new_config(s))
+    assert rn.status().progress == {}
+    rn.campaign()
+    status = rn.status()
+    assert status.basic.soft_state.lead == 1
+    assert status.raft_state == StateType.StateLeader
+    assert status.progress[1].match == rn.raft.prs.progress[1].match
+    assert status.config.voters.incoming == {1}
+    assert not status.config.voters.outgoing
+
+
+class IgnoreSizeHintMemStorage(MemoryStorage):
+    """ref: node_test.go ignoreSizeHintMemStorage — a user storage
+    whose Entries() is more permissive than raft's size hint."""
+
+    def entries(self, lo, hi, max_size):
+        return super().entries(lo, hi, 1 << 62)
+
+
+def test_rawnode_commit_pagination_after_restart():
+    """ref: rawnode_test.go:882-948 — regression: entries must be
+    applied gap-free even when the storage ignores the size hint."""
+    s = IgnoreSizeHintMemStorage()
+    s._snapshot.metadata.conf_state = ConfState(voters=[1])
+    s.set_hard_state(HardState(term=1, vote=1, commit=10))
+    ents = [Entry(term=1, index=i + 1, type=EntryType.EntryNormal,
+                  data=b"a") for i in range(10)]
+    size = sum(e.size() for e in ents)
+    s.ents = [Entry()] + list(ents)
+
+    cfg = new_config(s)
+    # Suggest to raft that the last committed entry should NOT be in
+    # the first CommittedEntries batch; the storage returns it anyway.
+    cfg.max_size_per_msg = size - ents[-1].size() - 1
+    s.ents.append(Entry(term=1, index=11, type=EntryType.EntryNormal,
+                        data=b"boom"))
+
+    rn = RawNode(cfg)
+    highest_applied = 0
+    while highest_applied != 11:
+        rd = rn.ready()
+        n = len(rd.committed_entries)
+        assert n > 0, f"stopped applying entries at {highest_applied}"
+        nxt = rd.committed_entries[0].index
+        assert highest_applied == 0 or highest_applied + 1 == nxt, (
+            f"attempting to apply index {nxt} after {highest_applied}"
+        )
+        highest_applied = rd.committed_entries[-1].index
+        rn.advance(rd)
+        rn.step(Message(type=MessageType.MsgHeartbeat, to=1, from_=1,
+                        term=1, commit=11))
+
+
+def test_rawnode_bounded_log_growth_with_partition():
+    """ref: rawnode_test.go:950-1035 — a partitioned leader's
+    uncommitted tail is bounded by max_uncommitted_entries_size."""
+    max_entries = 16
+    data = b"testdata"
+    test_entry = Entry(data=data)
+    max_entry_size = max_entries * test_entry.payload_size()
+
+    s = new_test_storage([1])
+    cfg = new_config(s)
+    cfg.max_uncommitted_entries_size = max_entry_size
+    rn = RawNode(cfg)
+    rd = rn.ready()
+    s.append(rd.entries)
+    rn.advance(rd)
+
+    # Become the leader.
+    rn.campaign()
+    while True:
+        rd = rn.ready()
+        s.append(rd.entries)
+        done = rd.soft_state is not None and rd.soft_state.lead == rn.raft.id
+        rn.advance(rd)
+        if done:
+            break
+
+    # Simulate a partition by never committing; propose 1024 entries.
+    for _ in range(1024):
+        try:
+            rn.propose(data)
+        except Exception:  # noqa: BLE001 — dropped proposals expected
+            pass
+    assert rn.raft.uncommitted_size == max_entry_size
+
+    # Recover: committing drains the uncommitted tail.
+    rd = rn.ready()
+    assert len(rd.committed_entries) == max_entries
+    s.append(rd.entries)
+    rn.advance(rd)
+    assert rn.raft.uncommitted_size == 0
+
+
+def test_rawnode_consume_ready():
+    """ref: rawnode_test.go:1075-1110 — ready_without_accept leaves
+    messages in place; ready() consumes them; advance keeps new ones."""
+    s = new_test_storage([1])
+    rn = RawNode(new_config(s))
+    m1 = Message(context=b"foo")
+    m2 = Message(context=b"bar")
+
+    rn.raft.msgs.append(m1)
+    rd = rn.ready_without_accept()
+    assert rd.messages == [m1]
+    assert rn.raft.msgs == [m1]
+
+    rd = rn.ready()
+    assert rn.raft.msgs == []
+    assert rd.messages == [m1]
+
+    rn.raft.msgs.append(m2)
+    rn.advance(rd)
+    assert rn.raft.msgs == [m2]
+
+
+def test_node_step():
+    """ref: node_test.go:46-77, adapted: the poll-style Node has a
+    command queue instead of propc/recvc channels. Local messages must
+    be dropped; every other type is enqueued."""
+    for msgt in MessageType:
+        s = new_test_storage([1])
+        n = Node.restart(new_config(s))
+        # Freeze the run loop queue inspection window by stopping the
+        # thread first: enqueue-after-stop raises, so inspect by
+        # behavior instead — step() must not raise for any type, and
+        # local messages must not reach the raft state machine.
+        before_term = n.rn.raft.term
+        n.step(Message(type=msgt, term=before_term + 10))
+        time.sleep(0.01)
+        if is_local_msg(msgt):
+            # Ignored: a local message with a huge term would have
+            # moved the term if it had been stepped.
+            assert n.rn.raft.term == before_term, msgt
+        n.stop()
+
+
+def test_ready_contain_updates():
+    """ref: node_test.go:558-576."""
+    cases = [
+        (Ready(), False),
+        (Ready(soft_state=SoftState(lead=1)), True),
+        (Ready(hard_state=HardState(vote=1)), True),
+        (Ready(entries=[Entry()]), True),
+        (Ready(committed_entries=[Entry()]), True),
+        (Ready(messages=[Message()]), True),
+        (Ready(snapshot=Snapshot(
+            metadata=SnapshotMetadata(index=1))), True),
+    ]
+    for i, (rd, want) in enumerate(cases):
+        assert rd.contains_updates() == want, f"#{i}"
+
+
+def test_node_start():
+    """ref: node_test.go:582-650 — a started node emits the bootstrap
+    conf change, then accepts and commits proposals."""
+    storage = MemoryStorage()
+    n = Node.start(new_config(storage), [Peer(id=1)])
+    try:
+        rd = n.ready(timeout=5.0)
+        assert rd is not None
+        assert rd.hard_state.term == 1 and rd.hard_state.commit == 1
+        assert len(rd.entries) == 1
+        assert rd.entries[0].type == EntryType.EntryConfChange
+        assert rd.entries[0].index == 1
+        assert rd.committed_entries == rd.entries
+        assert rd.must_sync
+        storage.append(rd.entries)
+        n.advance()
+
+        n.campaign()
+        rd = n.ready(timeout=5.0)
+        assert rd is not None
+        storage.append(rd.entries)
+        n.advance()
+
+        n.propose(b"foo", timeout=5.0)
+        deadline = time.monotonic() + 5.0
+        got = None
+        while time.monotonic() < deadline:
+            rd = n.ready(timeout=0.5)
+            if rd is None:
+                continue
+            storage.append(rd.entries)
+            if rd.committed_entries and rd.committed_entries[-1].data:
+                got = rd
+                n.advance()
+                break
+            n.advance()
+        assert got is not None
+        assert got.hard_state.term == 2 and got.hard_state.commit == 3
+        assert [bytes(e.data) for e in got.entries] == [b"foo"]
+        assert got.must_sync
+    finally:
+        n.stop()
+
+
+def test_node_advance():
+    """ref: node_test.go:742-777 — no new Ready until Advance."""
+    storage = MemoryStorage()
+    n = Node.start(new_config(storage), [Peer(id=1)])
+    try:
+        rd = n.ready(timeout=5.0)
+        assert rd is not None
+        storage.append(rd.entries)
+        n.advance()
+
+        n.campaign()
+        rd = n.ready(timeout=5.0)
+        assert rd is not None
+
+        n.propose(b"foo", timeout=5.0)
+        # Before Advance, no new Ready surfaces.
+        assert n.ready(timeout=0.05) is None
+        storage.append(rd.entries)
+        n.advance()
+        assert n.ready(timeout=5.0) is not None
+    finally:
+        n.stop()
+
+
+def test_soft_state_equal():
+    """ref: node_test.go:779-793."""
+    cases = [
+        (SoftState(), True),
+        (SoftState(lead=1), False),
+        (SoftState(raft_state=StateType.StateLeader), False),
+    ]
+    for i, (st, want) in enumerate(cases):
+        assert st.equal(SoftState()) == want, f"#{i}"
+
+
+def test_is_hard_state_equal():
+    """ref: node_test.go:795-811."""
+    empty = HardState()
+    cases = [
+        (HardState(), True),
+        (HardState(vote=1), False),
+        (HardState(commit=1), False),
+        (HardState(term=1), False),
+    ]
+    for i, (st, want) in enumerate(cases):
+        got = (st.term == empty.term and st.vote == empty.vote
+               and st.commit == empty.commit)
+        assert got == want, f"#{i}"
+        assert is_empty_hard_state(st) == want, f"#{i}"

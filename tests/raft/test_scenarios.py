@@ -56,6 +56,7 @@ class Network:
         self.storage = {}
         self.dropm = {}
         self.ignorem = set()
+        self.msg_hook = None  # ref: raft_test.go network.msgHook
         self._rand = random.Random(7)
         for j, p in enumerate(peers):
             nid = ids[j]
@@ -136,6 +137,8 @@ class Network:
                 continue
             assert m.type != MessageType.MsgHup, "unexpected MsgHup"
             if self._rand.random() < self.dropm.get((m.from_, m.to), 0.0):
+                continue
+            if self.msg_hook is not None and not self.msg_hook(m):
                 continue
             out.append(m)
         return out
