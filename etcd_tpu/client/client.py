@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..server import api as sapi
+from ..pkg import rpctypes
 from ..storage.mvcc.kv import Event
 from ..v3rpc import wire
 
@@ -43,10 +44,23 @@ IDEMPOTENT = {
 
 
 class ClientError(Exception):
-    def __init__(self, etype: str, msg: str = "") -> None:
+    def __init__(self, etype: str, msg: str = "",
+                 code: Optional[str] = None,
+                 grpc_code: Optional[int] = None) -> None:
         super().__init__(f"{etype}: {msg}")
         self.etype = etype
         self.msg = msg
+        # Canonical error identity (ref: api/v3rpc/rpctypes/error.go):
+        # retry/failover decisions key off these, not the class name.
+        self.code = code
+        self.grpc_code = grpc_code
+
+    def as_typed(self) -> Optional[Exception]:
+        """The canonical server-side exception, when this error carries
+        a table code (for callers that match on exception types)."""
+        if self.code is None:
+            return None
+        return rpctypes.exception_for(self.code, self.msg)
 
 
 class ConnClosed(Exception):
@@ -325,13 +339,28 @@ class Client:
                 return self._request_once(method, params, timeout, token=token)
             except ClientError as e:
                 last = e
-                if e.etype == "InvalidAuthTokenError" and not _no_reauth and self.username:
+                invalid_token = (
+                    e.code == "ErrInvalidAuthToken"
+                    or e.etype == "InvalidAuthTokenError"
+                )
+                if invalid_token and not _no_reauth and self.username:
                     self._authenticate_locked()
                     continue
                 retryable = e.etype in RETRYABLE and (
                     method in IDEMPOTENT or not getattr(e, "sent", True)
                 )
-                failover = e.etype in FAILOVER_ETYPES
+                # Failover on codes when the server sends them (gRPC
+                # Unavailable class, ref: retry_interceptor.go retrying
+                # on codes.Unavailable); class names only as the legacy
+                # fallback for code-less peers.
+                if e.code is not None or e.grpc_code is not None:
+                    failover = (
+                        e.grpc_code == int(rpctypes.Code.Unavailable)
+                        or e.code in rpctypes.FAILOVER_SYMBOLS
+                        or e.etype in FAILOVER_ETYPES
+                    )
+                else:
+                    failover = e.etype in FAILOVER_ETYPES
                 if not (retryable or failover):
                     raise
                 try:
@@ -387,7 +416,11 @@ class Client:
                 self._pending.pop(rid, None)
             raise ClientError("Timeout", f"{method} timed out")
         if p.error is not None:
-            e = ClientError(p.error["type"], p.error.get("msg", ""))
+            e = ClientError(
+                p.error["type"], p.error.get("msg", ""),
+                code=p.error.get("code"),
+                grpc_code=p.error.get("grpcCode"),
+            )
             e.sent = True
             raise e
         return p.result

@@ -9,6 +9,7 @@ import threading
 from typing import Any, Callable, Dict, Optional
 
 from . import wire
+from ..pkg import rpctypes
 
 
 class FramedServerConn:
@@ -36,8 +37,18 @@ class FramedServerConn:
     def encode_result(self, result: Any) -> Any:
         return result
 
-    def encode_error(self, e: Exception) -> Dict[str, str]:
-        return {"type": type(e).__name__, "msg": str(e)}
+    def encode_error(self, e: Exception) -> Dict[str, Any]:
+        """Typed error frame. Canonical-table errors carry a stable
+        symbolic code + gRPC status code (ref: api/v3rpc/rpctypes/
+        error.go); the class name rides along as ``type`` for older
+        peers."""
+        out: Dict[str, Any] = {"type": type(e).__name__, "msg": str(e)}
+        entry = rpctypes.entry_for_exception(e)
+        if entry is not None:
+            sym, code, _canonical = entry
+            out["code"] = sym
+            out["grpcCode"] = int(code)
+        return out
 
     def on_close(self) -> None:
         pass

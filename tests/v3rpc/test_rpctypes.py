@@ -1,0 +1,99 @@
+"""Canonical error-table round-trip (ref: api/v3rpc/rpctypes/error.go
++ error_test.go TestConvert): every table entry's exception class
+serializes to its stable symbolic code + gRPC code on the server frame
+and reconstructs to the same class on the client side; client failover
+decisions are driven by the codes."""
+
+import importlib
+
+import pytest
+
+from etcd_tpu.client.client import ClientError
+from etcd_tpu.pkg import rpctypes
+from etcd_tpu.pkg.rpctypes import TABLE, Code, FAILOVER_SYMBOLS
+from etcd_tpu.v3rpc.connbase import FramedServerConn
+
+
+class _Conn(FramedServerConn):
+    """encode_error shim — no socket needed."""
+
+    def __init__(self):
+        pass
+
+
+def _resolve(path):
+    mod, cls = path.rsplit(":", 1)
+    return getattr(importlib.import_module(mod), cls)
+
+
+@pytest.mark.parametrize("symbol", sorted(TABLE))
+def test_round_trip(symbol):
+    code, canonical_msg, path = TABLE[symbol]
+    cls = _resolve(path)
+    exc = cls(canonical_msg)
+
+    # Server side: serialize with the stable code.
+    frame = _Conn().encode_error(exc)
+    assert frame["code"] == symbol
+    assert frame["grpcCode"] == int(code)
+    assert frame["type"] == cls.__name__  # legacy field still present
+
+    # Client side: reconstruct the typed exception from the code.
+    rebuilt = rpctypes.exception_for(frame["code"], frame["msg"])
+    assert type(rebuilt) is cls
+    assert canonical_msg in str(rebuilt) or str(rebuilt) == frame["msg"]
+
+
+def test_every_symbol_resolves():
+    for symbol, (_code, _msg, path) in TABLE.items():
+        assert _resolve(path) is not None, symbol
+
+
+def test_grpc_codes_match_reference():
+    """Spot-check the gRPC code classes against rpctypes/error.go."""
+    assert TABLE["ErrCompacted"][0] == Code.OutOfRange
+    assert TABLE["ErrFutureRev"][0] == Code.OutOfRange
+    assert TABLE["ErrNoSpace"][0] == Code.ResourceExhausted
+    assert TABLE["ErrLeaseNotFound"][0] == Code.NotFound
+    assert TABLE["ErrLeaseExist"][0] == Code.FailedPrecondition
+    assert TABLE["ErrPermissionDenied"][0] == Code.PermissionDenied
+    assert TABLE["ErrInvalidAuthToken"][0] == Code.Unauthenticated
+    assert TABLE["ErrNoLeader"][0] == Code.Unavailable
+    assert TABLE["ErrNotLeader"][0] == Code.FailedPrecondition
+    assert TABLE["ErrStopped"][0] == Code.Unavailable
+    assert TABLE["ErrTimeout"][0] == Code.Unavailable
+    assert TABLE["ErrCorrupt"][0] == Code.DataLoss
+    assert TABLE["ErrRequestTooLarge"][0] == Code.InvalidArgument
+    assert TABLE["ErrTooManyRequests"][0] == Code.ResourceExhausted
+
+
+def test_failover_set_is_the_unavailable_class():
+    for symbol in FAILOVER_SYMBOLS:
+        assert TABLE[symbol][0] == Code.Unavailable
+    assert "ErrNoLeader" in FAILOVER_SYMBOLS
+    assert "ErrStopped" in FAILOVER_SYMBOLS
+    # NotLeader is FailedPrecondition (clients redirect, not blind
+    # failover) — matches the reference's code classes.
+    assert "ErrNotLeader" not in FAILOVER_SYMBOLS
+
+
+def test_client_error_as_typed():
+    e = ClientError("StoppedError", "etcdserver: server stopped",
+                    code="ErrStopped", grpc_code=int(Code.Unavailable))
+    typed = e.as_typed()
+    from etcd_tpu.server.server import StoppedError
+    assert isinstance(typed, StoppedError)
+    # Code-less legacy frame: no reconstruction.
+    assert ClientError("StoppedError", "x").as_typed() is None
+
+
+def test_unknown_code_returns_none():
+    assert rpctypes.exception_for("ErrNoSuchSymbol") is None
+    e = ClientError("WeirdError", "??")
+    assert e.code is None and e.as_typed() is None
+
+
+def test_untabled_exception_encodes_without_code():
+    frame = _Conn().encode_error(ValueError("boom"))
+    assert frame["type"] == "ValueError"
+    assert "code" not in frame and "grpcCode" not in frame
