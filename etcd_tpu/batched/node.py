@@ -68,13 +68,20 @@ class BatchedNode:
         pre_vote: bool = True,
         check_quorum: bool = True,
         restore: Optional[RowRestore] = None,
+        boot_conf_state: Optional[ConfState] = None,
+        capacity: int = 0,
     ) -> None:
         self.id = node_id
         self.peers = sorted(peers)
-        r = len(self.peers)
-        assert self.peers == list(range(1, r + 1)), (
+        assert self.peers == list(range(1, len(self.peers) + 1)), (
             "batched backend uses dense member ids 1..R"
         )
+        # Replica capacity R is a compiled shape: provision headroom
+        # beyond the boot peers so future member-adds have a slot
+        # (spare slots are inert — the kernel's replication/electorate
+        # sets are masked by voter|learner, so nothing is sent to them
+        # until a conf change admits the member).
+        r = max(capacity, len(self.peers))
         self.cfg = BatchedConfig(
             num_groups=1,
             num_replicas=r,
@@ -119,12 +126,25 @@ class BatchedNode:
         from ..raft.tracker import ProgressTracker
 
         self._conf_tracker = ProgressTracker(max_inflight=256)
-        boot_cs = restore.conf_state if restore is not None and getattr(
-            restore, "conf_state", None) else ConfState(
-            voters=list(self.peers))
-        cc_restore(Changer(self._conf_tracker, 0), boot_cs)
+        if restore is not None and getattr(restore, "conf_state", None):
+            boot_cs = restore.conf_state
+        elif boot_conf_state is not None:
+            # Joiner boot: the caller dictates the starting config —
+            # typically voterless (empty), so this member cannot
+            # campaign or count its own vote until the admitting conf
+            # change applies from the replicated log (the device twin
+            # of Node.restart-with-empty-config semantics,
+            # ref: etcdserver/bootstrap.go:513-521 RestartNode).
+            boot_cs = boot_conf_state
+        else:
+            boot_cs = ConfState(voters=list(self.peers))
+        if boot_cs.voters or boot_cs.learners or boot_cs.voters_outgoing:
+            cc_restore(Changer(self._conf_tracker, 0), boot_cs)
         cs0 = self._conf_tracker.conf_state()
-        if (sorted(cs0.voters) != list(self.peers) or cs0.learners
+        # The device boots with ALL R slots as voters (init_state);
+        # upload masks whenever the true config differs — including
+        # when spare capacity slots exist beyond the boot peers.
+        if (sorted(cs0.voters) != list(range(1, r + 1)) or cs0.learners
                 or cs0.voters_outgoing):
             self.rn.set_membership(
                 0,
@@ -363,6 +383,31 @@ class BatchedNode:
                     del self._inbound_snaps[k]
             if stash is not None:
                 snapshot = stash
+                # An installed snapshot carries the sender's membership;
+                # entries between our log and the snapshot (which may
+                # include conf changes) are skipped, so the config must
+                # be restored from the metadata — the device twin of
+                # raft.restore() → confchange.Restore
+                # (ref: raft.go:1589-1605, confchange/restore.go:155).
+                cs = stash.metadata.conf_state
+                if cs.voters or cs.learners or cs.voters_outgoing:
+                    from ..raft.confchange import (
+                        Changer,
+                        restore as cc_restore,
+                    )
+                    from ..raft.tracker import ProgressTracker
+
+                    with self._lock:
+                        tr = ProgressTracker(max_inflight=256)
+                        cc_restore(Changer(tr, idx), cs)
+                        self._conf_tracker = tr
+                    self.rn.set_membership(
+                        0,
+                        voters=[v - 1 for v in cs.voters],
+                        voters_out=[v - 1 for v in cs.voters_outgoing],
+                        learners=[v - 1 for v in cs.learners],
+                        joint=bool(cs.voters_outgoing),
+                    )
             else:
                 snapshot = Snapshot(
                     metadata=SnapshotMetadata(

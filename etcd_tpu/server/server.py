@@ -47,6 +47,7 @@ from ..raft.rawnode import Ready
 from ..raft.storage import MemoryStorage
 from ..raft.types import (
     ConfChange,
+    ConfState,
     ConfChangeType,
     ConfChangeV2,
     Entry,
@@ -156,6 +157,10 @@ class ServerConfig:
     # (requires dense member ids 1..R; ref: SURVEY §7.6
     # --experimental-raft-backend plumbing at bootstrapRaft).
     raft_backend: str = "host"
+    # tpu backend only: provisioned replica slots (compiled shape).
+    # 0 = len(peers). Member-adds beyond this capacity are rejected;
+    # provision headroom when the cluster is expected to grow.
+    replica_capacity: int = 0
 
 
 @dataclass
@@ -385,22 +390,22 @@ class EtcdServer:
         from ..batched.node import BatchedNode
         from ..batched.rawnode import RowRestore
 
-        if self.cfg.join:
-            # The batched layout boots with the full voter mask; a
-            # joiner must come up voterless until its admitting conf
-            # change commits (Node.restart semantics) — not implemented
-            # on the device path, and silently granting votes before
-            # admission is the split-brain the flag prevents.
-            raise NotImplementedError(
-                "raft_backend='tpu' does not support join=True; "
-                "bootstrap the member in the initial cluster or use "
-                "the host backend")
+        joiner_boot = self.cfg.join and not old_wal
         if not old_wal:
             # Fresh boot: the host path seeds the member registry via
             # bootstrap ConfChange entries (Node.start); the batched
             # engine boots with membership as initial state, so seed
-            # the registry directly with the same Member contexts.
+            # the registry directly with the same Member contexts. A
+            # JOINER seeds everyone but itself — its own membership
+            # (registry entry AND device vote mask) arrives only via
+            # the admitting ConfChange in the replicated log, so it
+            # cannot campaign or count its own vote before admission
+            # (ref: etcdserver/bootstrap.go:487-536; operators pass the
+            # current member list via --initial-cluster, exactly what
+            # `etcdctl member add` prints).
             for p in self.cfg.peers:
+                if p == self.id and self.cfg.join:
+                    continue
                 if self.cluster.member(p) is None:
                     self.cluster.add_member(Member(id=p, name=f"m{p}"))
 
@@ -439,6 +444,12 @@ class EtcdServer:
             window=window,
             pre_vote=self.cfg.pre_vote,
             restore=restore,
+            boot_conf_state=(
+                ConfState(voters=[p for p in self.cfg.peers
+                                  if p != self.id])
+                if joiner_boot else None
+            ),
+            capacity=self.cfg.replica_capacity,
         )
         if restore is not None and not is_empty_snap(snap):
             # Seed the node's app snapshot so lagging followers can be
