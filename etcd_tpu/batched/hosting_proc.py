@@ -1,0 +1,336 @@
+"""Multi-raft hosting members as real OS processes.
+
+One process = one ``MultiRaftMember`` (slot of every group) wired to its
+peers by ``TCPRouter`` over real sockets — the deployment shape of the
+reference, where each peer is a separate process reached via rafthttp
+(ref: server/etcdserver/api/rafthttp/transport.go:97-132, Procfile).
+
+The process exposes a small line-delimited JSON admin API on a local
+TCP port so harnesses (tests/e2e, tools/multiraft_proc_demo) can drive
+puts/reads, trigger campaigns, run a hosted-path benchmark, and stop it.
+Run as::
+
+    python -m etcd_tpu.batched.hosting_proc --id 1 --members 3 \
+        --groups 1024 --data-dir /tmp/mr --bind 127.0.0.1:7001 \
+        --admin 127.0.0.1:8001 --peer 2=127.0.0.1:7002 --peer 3=...
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# NB: jax import happens inside MultiRaftMember; keep module import
+# cheap so the spawning harness can import the client half freely.
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s.encode())
+
+
+# -- server side ---------------------------------------------------------------
+
+
+class AdminServer:
+    """Line-delimited JSON admin endpoint for one member process."""
+
+    def __init__(self, member, router, bind: Tuple[str, int]) -> None:
+        self.member = member
+        self.router = router
+        self._stopping = threading.Event()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(bind)
+        self._srv.listen(8)
+        self.addr = self._srv.getsockname()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        f = conn.makefile("rwb")
+        try:
+            for line in f:
+                req: Dict = {}
+                try:
+                    req = json.loads(line)
+                    resp = self._handle(req)
+                except Exception as e:  # noqa: BLE001 — report to caller
+                    resp = {"err": f"{type(e).__name__}: {e}"}
+                f.write(json.dumps(resp).encode() + b"\n")
+                f.flush()
+                if req.get("op") == "stop":
+                    break
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, req: Dict) -> Dict:
+        m = self.member
+        op = req["op"]
+        if op == "ping":
+            return {"ok": True, "id": m.id}
+        if op == "campaign":
+            m.campaign(req["groups"])
+            return {"ok": True}
+        if op == "leaders":
+            import numpy as np
+
+            from .state import LEADER
+
+            mask = np.asarray(m.rn.m_role == LEADER)
+            leads = [int(m.rn.lead(g)) for g in req.get(
+                "groups", range(m.g))]
+            return {"ok": True, "leads": leads,
+                    "own": int(mask.sum())}
+        if op == "put":
+            g = req["g"]
+            from .hosting import GroupKV
+
+            payload = GroupKV.put_payload(_unb64(req["k"]), _unb64(req["v"]))
+            if not m.propose(g, payload):
+                return {"ok": False, "redirect": m.leader_of(g)}
+            return {"ok": True}
+        if op == "get":
+            v = m.get(req["g"], _unb64(req["k"]))
+            return {"ok": True, "v": _b64(v) if v is not None else None}
+        if op == "lget":
+            try:
+                v = m.linearizable_get(req["g"], _unb64(req["k"]),
+                                       timeout=req.get("timeout", 10.0))
+            except Exception as e:  # noqa: BLE001 — NotLeader/Timeout
+                return {"ok": False, "err": type(e).__name__}
+            return {"ok": True, "v": _b64(v) if v is not None else None}
+        if op == "applied":
+            g = req["g"]
+            return {"ok": True, "applied": int(m.applied_index[g])}
+        if op == "bench":
+            return self._bench(int(req["n"]),
+                               int(req.get("value_size", 64)))
+        if op == "stop":
+            threading.Thread(target=self._shutdown, daemon=True).start()
+            return {"ok": True}
+        return {"err": f"unknown op {op}"}
+
+    def _bench(self, n: int, value_size: int) -> Dict:
+        """Hosted-path benchmark: propose n entries across the groups
+        this member leads, confirm each applied locally (read-your-
+        write at the leader), report throughput + commit p50/p99 —
+        the service-rate number next to bench.py's kernel rate."""
+        import numpy as np
+
+        from .hosting import GroupKV
+        from .state import LEADER
+
+        m = self.member
+        own = [g for g in range(m.g) if m.is_leader(g)]
+        if not own:
+            return {"err": "no groups led by this member"}
+        val = b"v" * value_size
+        t_start = time.perf_counter()
+        # Pipeline: propose in waves to bound the per-group inflight
+        # (the engine caps proposals staged per round).
+        lat: List[float] = []
+        done_keys: List[Tuple[int, bytes, float]] = []
+        i = 0
+        while i < n or done_keys:
+            while i < n and len(done_keys) < 4 * len(own):
+                g = own[i % len(own)]
+                k = b"bench-%d" % i
+                if m.propose(g, GroupKV.put_payload(k, val)):
+                    done_keys.append((g, k, time.perf_counter()))
+                i += 1
+            still = []
+            for g, k, t0 in done_keys:
+                if m.get(g, k) is not None:
+                    lat.append(time.perf_counter() - t0)
+                else:
+                    still.append((g, k, t0))
+            done_keys = still
+            if done_keys:
+                time.sleep(0.001)
+        dt = time.perf_counter() - t_start
+        lat_ms = sorted(x * 1000 for x in lat)
+        return {
+            "ok": True,
+            "n": n,
+            "groups": len(own),
+            "puts_per_sec": round(n / dt, 1),
+            "p50_ms": round(lat_ms[len(lat_ms) // 2], 3),
+            "p99_ms": round(lat_ms[int(len(lat_ms) * 0.99) - 1], 3),
+        }
+
+    def _shutdown(self) -> None:
+        self._stopping.set()
+        try:
+            self.member.stop()
+        finally:
+            self.router.stop()
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+            # Hard-exit: daemon threads (jax runtime included) must not
+            # keep the worker alive after an orderly stop.
+            os._exit(0)
+
+
+def serve(member_id: int, num_members: int, num_groups: int,
+          data_dir: str, bind: Tuple[str, int],
+          admin: Tuple[str, int],
+          peers: Dict[int, Tuple[str, int]],
+          window: int = 32,
+          tick_interval: float = 0.05) -> None:
+    from .hosting import MultiRaftMember
+    from .state import BatchedConfig
+
+    cfg = BatchedConfig(
+        num_groups=num_groups,
+        num_replicas=num_members,
+        window=window,
+        max_ents_per_msg=4,
+        max_props_per_round=4,
+        election_timeout=10,
+        heartbeat_timeout=1,
+        pre_vote=True,
+        check_quorum=True,
+        auto_compact=True,
+    )
+    member = MultiRaftMember(
+        member_id, num_members, num_groups, data_dir, cfg=cfg,
+        tick_interval=tick_interval,
+    )
+    from .hosting import TCPRouter
+
+    router = TCPRouter(member, bind=bind)
+    for pid, addr in peers.items():
+        router.add_peer(pid, addr)
+    srv = AdminServer(member, router, admin)
+    member.start()
+    print(f"member {member_id} serving: raft={router.addr} "
+          f"admin={srv.addr} groups={num_groups}", flush=True)
+    threading.Event().wait()  # park; admin 'stop' hard-exits
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--id", type=int, required=True)
+    p.add_argument("--members", type=int, required=True)
+    p.add_argument("--groups", type=int, required=True)
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--bind", required=True)
+    p.add_argument("--admin", required=True)
+    p.add_argument("--peer", action="append", default=[],
+                   help="peerid=host:port (repeatable)")
+    p.add_argument("--window", type=int, default=32)
+    p.add_argument("--tick-interval", type=float, default=0.05)
+    a = p.parse_args(argv)
+
+    def hp(s: str) -> Tuple[str, int]:
+        h, _, pt = s.rpartition(":")
+        return h, int(pt)
+
+    peers = {}
+    for spec in a.peer:
+        pid, _, addr = spec.partition("=")
+        peers[int(pid)] = hp(addr)
+    serve(a.id, a.members, a.groups, a.data_dir, hp(a.bind),
+          hp(a.admin), peers, window=a.window,
+          tick_interval=a.tick_interval)
+
+
+# -- client side ---------------------------------------------------------------
+
+
+class ProcClient:
+    """Admin-API client for one member process."""
+
+    def __init__(self, addr: Tuple[str, int], timeout: float = 60.0):
+        self.addr = addr
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._f = None
+        self._lock = threading.Lock()
+
+    def _ensure(self) -> None:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self.addr, timeout=self.timeout)
+            self._f = self._sock.makefile("rwb")
+
+    def call(self, **req) -> Dict:
+        with self._lock:
+            self._ensure()
+            try:
+                self._f.write(json.dumps(req).encode() + b"\n")
+                self._f.flush()
+                line = self._f.readline()
+            except OSError:
+                self.close()
+                raise
+            if not line:
+                self.close()
+                raise ConnectionError("admin connection closed")
+            return json.loads(line)
+
+    def put(self, g: int, k: bytes, v: bytes) -> Dict:
+        return self.call(op="put", g=g, k=_b64(k), v=_b64(v))
+
+    def get(self, g: int, k: bytes) -> Optional[bytes]:
+        r = self.call(op="get", g=g, k=_b64(k))
+        return _unb64(r["v"]) if r.get("v") else None
+
+    def lget(self, g: int, k: bytes, timeout: float = 10.0) -> Dict:
+        return self.call(op="lget", g=g, k=_b64(k), timeout=timeout)
+
+    def close(self) -> None:
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        self._f = None
+
+
+def wait_admin(addr: Tuple[str, int], timeout: float = 120.0) -> ProcClient:
+    """Wait for a member process's admin endpoint to come up (device
+    program compile happens at process start and can take a while)."""
+    deadline = time.monotonic() + timeout
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            c = ProcClient(addr)
+            r = c.call(op="ping")
+            if r.get("ok"):
+                return c
+        except (OSError, ConnectionError, ValueError) as e:
+            last = e
+        time.sleep(0.25)
+    raise TimeoutError(f"admin {addr} not up: {last}")
+
+
+if __name__ == "__main__":
+    main()

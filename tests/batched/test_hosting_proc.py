@@ -1,0 +1,188 @@
+"""Multi-raft hosting layer as real OS processes: 3 MultiRaftMember
+workers wired by TCPRouter over real sockets at G=1024, driven through
+the admin API — the reference's deployment shape (each peer its own
+process, ref: rafthttp/transport.go:97-132, Procfile; e2e process
+discipline of tests/e2e). Covers puts across groups, kill -9 and
+restart of a member (WAL replay + catch-up at the hosting layer), and
+records a hosted-path throughput/commit-p50 line."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from etcd_tpu.batched.hosting_proc import ProcClient, wait_admin
+
+G = 1024
+MEMBERS = 3
+
+pytestmark = pytest.mark.e2e
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def spawn(mid, raft_ports, admin_ports, data_dir, gen=0):
+    peers = [
+        f"--peer={pid}=127.0.0.1:{raft_ports[pid]}"
+        for pid in range(1, MEMBERS + 1) if pid != mid
+    ]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    # Logs go to files: an undrained PIPE would wedge the worker once
+    # the buffer fills with XLA/compile chatter.
+    log = open(os.path.join(data_dir, f"worker-{mid}-gen{gen}.log"), "wb")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "etcd_tpu.batched.hosting_proc",
+            "--id", str(mid), "--members", str(MEMBERS),
+            "--groups", str(G), "--data-dir", data_dir,
+            "--bind", f"127.0.0.1:{raft_ports[mid]}",
+            "--admin", f"127.0.0.1:{admin_ports[mid]}",
+            "--tick-interval", "0.02",
+        ] + peers,
+        env=env,
+        stdout=log,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def put_any(clients, g, k, v, timeout=30.0):
+    """Client-style redirect loop: try members until the leader takes
+    the proposal and the write is readable at that member."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for c in clients.values():
+            try:
+                r = c.put(g, k, v)
+            except (OSError, ConnectionError):
+                continue
+            if r.get("ok"):
+                sub = min(deadline, time.monotonic() + 2.0)
+                while time.monotonic() < sub:
+                    if c.get(g, k) == v:
+                        return c
+                    time.sleep(0.01)
+        time.sleep(0.05)
+    raise TimeoutError(f"put group {g} never committed")
+
+
+def wait_all_leaders(client, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    nudge = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        r = client.call(op="leaders")
+        leads = r["leads"]
+        if all(x > 0 for x in leads):
+            return leads
+        if time.monotonic() > nudge:
+            stuck = [g for g, x in enumerate(leads) if x == 0]
+            client.call(op="campaign", groups=stuck[:512])
+            nudge = time.monotonic() + 5.0
+        time.sleep(0.25)
+    raise TimeoutError("groups without leader")
+
+
+def test_three_process_cluster_kill9_restart(tmp_path):
+    raft_p = dict(zip(range(1, MEMBERS + 1), free_ports(MEMBERS)))
+    admin_p = dict(zip(range(1, MEMBERS + 1), free_ports(MEMBERS)))
+    procs = {}
+    clients = {}
+    try:
+        for mid in range(1, MEMBERS + 1):
+            procs[mid] = spawn(mid, raft_p, admin_p, str(tmp_path))
+        for mid in range(1, MEMBERS + 1):
+            clients[mid] = wait_admin(("127.0.0.1", admin_p[mid]),
+                                      timeout=180.0)
+
+        # Balanced leadership: member m campaigns groups g % 3 == m-1.
+        for mid, c in clients.items():
+            c.call(op="campaign",
+                   groups=[g for g in range(G) if g % MEMBERS == mid - 1])
+        wait_all_leaders(clients[1])
+
+        # Puts across the group space via redirect loop.
+        sample = list(range(0, G, 97)) + [G - 1]
+        for g in sample:
+            put_any(clients, g, b"k", b"v%d" % g)
+
+        # Hosted-path perf line (throughput + commit p50) on member 1.
+        bench = clients[1].call(op="bench", n=300, value_size=64)
+        assert bench.get("ok"), bench
+        print(f"\nhosted-path: {bench['puts_per_sec']} puts/s over "
+              f"{bench['groups']} groups, commit p50 "
+              f"{bench['p50_ms']}ms p99 {bench['p99_ms']}ms")
+        assert bench["puts_per_sec"] > 0
+
+        # kill -9 member 3: quorum survives, its groups re-elect.
+        procs[3].kill()
+        procs[3].wait(timeout=10)
+        clients[3].close()
+        g3 = next(g for g in sample if g % MEMBERS == 2)
+        survivors = {m: c for m, c in clients.items() if m != 3}
+        put_any(survivors, g3, b"after-kill", b"1", timeout=60.0)
+        # A group that was led elsewhere still serves writes.
+        g1 = next(g for g in sample if g % MEMBERS == 0)
+        put_any(survivors, g1, b"after-kill", b"1", timeout=60.0)
+
+        # Restart member 3 from the same data dir: WAL replay +
+        # snapshot/append catch-up at the hosting layer.
+        procs[3] = spawn(3, raft_p, admin_p, str(tmp_path), gen=1)
+        clients[3] = wait_admin(("127.0.0.1", admin_p[3]), timeout=180.0)
+
+        deadline = time.monotonic() + 120.0
+        want = {g: b"v%d" % g for g in sample}
+        want[g3] = want[g3]  # original key still present
+        while time.monotonic() < deadline:
+            missing = [
+                g for g in sample
+                if clients[3].get(g, b"k") != want[g]
+            ]
+            if not missing and clients[3].get(g3, b"after-kill") == b"1":
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"restarted member did not catch up: {missing}")
+
+        # And it participates again: a fresh write lands everywhere.
+        c = put_any(clients, g3, b"after-restart", b"2", timeout=60.0)
+        assert c is not None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if clients[3].get(g3, b"after-restart") == b"2":
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail("restarted member missed post-restart write")
+    finally:
+        for c in clients.values():
+            try:
+                c.call(op="stop")
+            except Exception:  # noqa: BLE001
+                pass
+            c.close()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=5)
