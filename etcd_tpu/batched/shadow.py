@@ -119,7 +119,12 @@ class ShadowCluster:
         deterministic_timeouts: bool = False,
         auto_compact_window: int = 0,
         max_ents: Optional[int] = None,
+        merged_deliver: bool = False,
     ):
+        # Mirrors BatchedConfig.merged_deliver: the device's delivery
+        # order is kind-major (six lane scans) or sender-major within
+        # request/response halves (two merged scans).
+        self.merged_deliver = merged_deliver
         self.r = num_replicas
         self.nodes: List[RawNode] = []
         lrn = {s + 1 for s in learners}
@@ -175,22 +180,35 @@ class ShadowCluster:
         transfers = transfers or {}
         drops = set(drop_pairs)
 
-        # Phase 1: deliver, fixed (kind, sender) order per target — the
-        # device processes lane-by-lane with senders ascending within a
-        # lane (step.py _deliver_all).
+        # Phase 1: deliver in the exact order of the device's
+        # configured scan shape (step.py _deliver_all): kind-major for
+        # the six lane scans, or request/response halves sender-major
+        # for the two merged scans.
+        if self.merged_deliver:
+            order = [
+                (sender, kind)
+                for kinds in (range(0, 3), range(3, NUM_KINDS))
+                for sender in range(self.r)
+                for kind in kinds
+            ]
+        else:
+            order = [
+                (sender, kind)
+                for kind in range(NUM_KINDS)
+                for sender in range(self.r)
+            ]
         inbox, self.inbox = self.inbox, self._empty_inbox()
         for target in range(self.r):
             if target in iso:
                 continue
-            for kind in range(NUM_KINDS):
-                for sender in range(self.r):
-                    m = inbox[target][sender][kind]
-                    if m is None:
-                        continue
-                    try:
-                        self.nodes[target].step(m)
-                    except RaftError:
-                        pass
+            for sender, kind in order:
+                m = inbox[target][sender][kind]
+                if m is None:
+                    continue
+                try:
+                    self.nodes[target].step(m)
+                except RaftError:
+                    pass
 
         # Phase 2: tick / explicit campaigns.
         if tick:

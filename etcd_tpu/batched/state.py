@@ -53,6 +53,14 @@ class BatchedConfig(NamedTuple):
     # stays [N, ...]; the jitted round transposes at entry/exit.
     # bench.py probes both layouts and picks the faster one per device.
     lanes_minor: bool = False
+    # Deliver-scan shape: False = six length-R scans (one per kind
+    # lane, kind-major order); True = two length-R scans (request and
+    # response halves, sender-major order) with 3x bigger fused bodies.
+    # Semantically equivalent protocols with DIFFERENT delivery orders
+    # (the shadow oracle mirrors whichever is set). CPU favors the six
+    # small scans ~2x; the merged shape exists for TPU measurement,
+    # where per-iteration overhead, not vector width, bounds the round.
+    merged_deliver: bool = False
 
     @property
     def num_instances(self) -> int:

@@ -19,7 +19,7 @@ routing between instances of the same group is a single transpose over
 the (sender, target) axes — no scatters, no host round-trips. A round
 is one jitted program:
 
-    deliver (scan over R*K slots) → tick → propose → emit → route
+    deliver (2 sender scans: requests then responses) → tick → propose → emit → route
 
 Determinism: randomized election timeouts use a per-instance hash of
 (instance id, reset count), reproducible by the host oracle for
@@ -731,11 +731,28 @@ _LANE_HANDLERS = (
 
 def _deliver_all(cfg: BatchedConfig, iid, slot, st: BatchedState,
                  inbox: MsgSlots):
-    """Deliver this instance's inbox lane-by-lane (senders in ascending
-    order within a lane — the fixed order the shadow oracle replicates).
-    Each lane runs its specialized handler, so a slot only ever pays for
-    the message types that can land in it; responses are collected for
-    the request lanes 0..2 and route back in lanes 3..5."""
+    """Deliver this instance's inbox; the scan shape is configured:
+
+    * ``merged_deliver=False`` (default): six length-R scans, one per
+      kind lane, senders ascending within a lane (kind-major order).
+      Small bodies; CPU-friendly.
+    * ``merged_deliver=True``: two length-R scans — request half
+      (kinds 0..2) then response half — each body chaining the three
+      kind handlers for one sender (sender-major order within a half).
+      Same 18 handler applications, 3x bigger fused bodies, a third of
+      the loop-carry round trips; built for TPU, where per-iteration
+      overhead bounds the round.
+
+    Either way, responses are collected for the request lanes 0..2 and
+    route back in lanes 3..5, and the shadow oracle replicates the
+    exact delivery order of the configured shape."""
+    if cfg.merged_deliver:
+        return _deliver_merged(cfg, iid, slot, st, inbox)
+    return _deliver_lanes(cfg, iid, slot, st, inbox)
+
+
+def _deliver_lanes(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                   inbox: MsgSlots):
     r = cfg.num_replicas
     senders = jnp.arange(r, dtype=I32)
 
@@ -760,6 +777,42 @@ def _deliver_all(cfg: BatchedConfig, iid, slot, st: BatchedState,
     # [R] per request lane → [R, 3].
     req = jax.tree.map(
         lambda a, b, c: jnp.stack((a, b, c), axis=1), *req_resps
+    )
+    return st, req
+
+
+def _deliver_merged(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                    inbox: MsgSlots):
+    r = cfg.num_replicas
+    senders = jnp.arange(r, dtype=I32)
+
+    req_inbox = jax.tree.map(lambda x: x[:, :3], inbox)  # [R, 3, ...]
+
+    def req_body(carry, xs):
+        msgs, s = xs  # msgs leaves: [3, ...]
+        resps = []
+        for k, handler in enumerate(_LANE_HANDLERS[:3]):
+            m = jax.tree.map(lambda x, _k=k: x[_k], msgs)
+            carry, resp = handler(cfg, iid, slot, carry, m, s)
+            resps.append(resp)
+        return carry, tuple(resps)
+
+    st, (r0, r1, r2) = jax.lax.scan(req_body, st, (req_inbox, senders))
+
+    resp_inbox = jax.tree.map(lambda x: x[:, 3:], inbox)  # [R, 3, ...]
+
+    def resp_body(carry, xs):
+        msgs, s = xs
+        for k, handler in enumerate(_LANE_HANDLERS[3:]):
+            m = jax.tree.map(lambda x, _k=k: x[_k], msgs)
+            carry = handler(cfg, iid, slot, carry, m, s)
+        return carry, 0
+
+    st, _ = jax.lax.scan(resp_body, st, (resp_inbox, senders))
+
+    # [R] per request lane → [R, 3].
+    req = jax.tree.map(
+        lambda a, b, c: jnp.stack((a, b, c), axis=1), r0, r1, r2
     )
     return st, req
 

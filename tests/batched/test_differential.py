@@ -19,7 +19,7 @@ R = 3
 ET = 1 << 20  # no timer elections inside the differential envelope
 
 
-def make_pair(groups=2):
+def make_pair(groups=2, merged_deliver=False):
     cfg = BatchedConfig(
         num_groups=groups,
         num_replicas=R,
@@ -29,9 +29,11 @@ def make_pair(groups=2):
         election_timeout=ET,
         heartbeat_timeout=1,
         max_inflight=1 << 20,
+        merged_deliver=merged_deliver,
     )
     eng = MultiRaftEngine(cfg)
-    shadows = [ShadowCluster(R, election_timeout=ET, heartbeat_timeout=1)
+    shadows = [ShadowCluster(R, election_timeout=ET, heartbeat_timeout=1,
+                             merged_deliver=merged_deliver)
                for _ in range(groups)]
     return cfg, eng, shadows
 
@@ -109,8 +111,9 @@ def run_lockstep(cfg, eng, shadows, schedule):
             )
 
 
-def test_election_and_replication_lockstep():
-    cfg, eng, shadows = make_pair(groups=2)
+@pytest.mark.parametrize("merged", [False, True])
+def test_election_and_replication_lockstep(merged):
+    cfg, eng, shadows = make_pair(groups=2, merged_deliver=merged)
     schedule = (
         [{"campaign": [(0, 0), (1, 2)]}]
         + [{} for _ in range(4)]
@@ -132,7 +135,7 @@ def test_partition_divergence_and_heal_lockstep():
     a new leader at a higher term; on heal the old leader's divergent
     tail is truncated via the reject-hint probe path
     (ref: raft.go:1109-1236)."""
-    cfg, eng, shadows = make_pair(groups=1)
+    cfg, eng, shadows = make_pair(groups=1, merged_deliver=True)
     iso0 = [(0, 0)]
     schedule = (
         [{"campaign": [(0, 0)]}]
