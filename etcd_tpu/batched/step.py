@@ -1100,7 +1100,8 @@ def route(cfg: BatchedConfig, outbox: MsgSlots) -> MsgSlots:
         y = jnp.swapaxes(y, 1, 2)
         return y.reshape((g * r,) + x.shape[1:])
 
-    inbox = jax.tree.map(tr, outbox)
+    with jax.named_scope("raft_route"):
+        inbox = jax.tree.map(tr, outbox)
     # Requests (kinds 0..2) arrive as-is; responses were produced into
     # kinds 0..2 of the responder's outbox rows and must land in kinds
     # 3..5 of the requester's inbox. The emit/deliver split already wrote
@@ -1140,14 +1141,22 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
                          iso, tr_to, rd_req):
             # Partitioned instances neither receive nor send this round
             # (fault injection; ref: tests/framework bridge & pkg/proxy).
+            # Phases carry jax.named_scope annotations so xprof/JAX
+            # profiler traces attribute device time per phase (SURVEY
+            # §5 tracing: profiler hooks around the step kernel).
             inbox_i = inbox_i._replace(valid=inbox_i.valid & ~iso)
-            sti, req_resps = _deliver_all(cfg, iid, slot, sti, inbox_i)
-            sti = _tick(cfg, iid, slot, sti, do_tick, do_camp)
+            with jax.named_scope("raft_deliver"):
+                sti, req_resps = _deliver_all(cfg, iid, slot, sti, inbox_i)
+            with jax.named_scope("raft_tick"):
+                sti = _tick(cfg, iid, slot, sti, do_tick, do_camp)
             read_snap = (sti.read_seq, sti.read_index, sti.read_ready)
-            sti = _control(cfg, slot, sti, tr_to, rd_req)
+            with jax.named_scope("raft_control"):
+                sti = _control(cfg, slot, sti, tr_to, rd_req)
             last_tick = sti.last
-            sti = _propose(cfg, slot, sti, n_new)
-            sti, out = _emit(cfg, slot, sti)
+            with jax.named_scope("raft_propose"):
+                sti = _propose(cfg, slot, sti, n_new)
+            with jax.named_scope("raft_emit"):
+                sti, out = _emit(cfg, slot, sti)
             # Responses to requests from sender s (kinds 0..2) land in
             # out[s, 3+k]; they route back by the same transpose.
             out = jax.tree.map(

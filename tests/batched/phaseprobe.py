@@ -3,8 +3,14 @@
 where round wall-time goes. Not a test.
 
 Usage: python tests/batched/phaseprobe.py [G] [minor|major]
+
+Set PHASEPROBE_TRACE=<dir> to additionally capture a JAX profiler
+trace of the timed region (phases carry jax.named_scope annotations —
+raft_deliver/tick/control/propose/emit/route — so xprof attributes
+device time per phase; SURVEY §5 tracing hooks).
 """
 
+import os
 import sys
 import time
 
@@ -64,6 +70,7 @@ def main() -> None:
             body, (st, inbox), jnp.arange(rounds, dtype=jnp.int32)
         )[0]
 
+    trace_dir = os.environ.get("PHASEPROBE_TRACE")
     for name, fn in (("full", loop_full), ("step", loop_step),
                      ("route2x", loop_route)):
         jfn = jax.jit(fn)
@@ -71,6 +78,11 @@ def main() -> None:
         out = jfn(eng.state, eng.inbox)
         jax.block_until_ready(out[0].commit)
         tc = time.perf_counter() - t0
+        if trace_dir and name == "full":
+            with jax.profiler.trace(trace_dir):
+                out = jfn(eng.state, eng.inbox)
+                jax.block_until_ready(out[0].commit)
+            print(f"profiler trace written to {trace_dir}", flush=True)
         t0 = time.perf_counter()
         calls = 4
         for _ in range(calls):
