@@ -1,0 +1,165 @@
+"""Pallas TPU kernels for the batched engine's replica/window
+reductions (SURVEY §7.3: the fused step(+quorum) kernel study).
+
+Two fused kernels, batched over the instance axis N (laid out
+lanes-minor, [R|W, N], so the 128-wide vector lanes fill with
+instances — the same layout argument as BatchedConfig.lanes_minor):
+
+* ``quorum_commit_vote`` — joint-config commit index AND vote result
+  in one VMEM pass (ref: raft/quorum/majority.go:126-210,
+  joint.go:49-75). The commit index uses the quorum-support
+  formulation (the reference's cross-checked alternative definition,
+  quorum/quick_test.go:85): the largest candidate match value
+  supported by ≥ n//2+1 voters — an O(R²) elementwise form with no
+  sort, which is what the VPU wants.
+* ``term_at_batch`` — ring term lookup as a one-hot compare+reduce
+  over the window axis (ref: the zero-term-outside-bounds contract of
+  raft/log.go term()).
+
+Both run under ``interpret=True`` on CPU for differential testing
+against the XLA forms in kernels.py; on TPU they compile natively.
+Integration into the round kernel is gated on TPU measurement (see
+BENCH_NOTES.md): the XLA forms already fuse well, so the Pallas forms
+must beat them on-device before they take over the hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .kernels import MAX_I32, VOTE_LOST, VOTE_PENDING, VOTE_WON
+from .state import I32
+
+_TILE_N = 512  # lane-axis tile: instances per grid step (multiple of 128)
+
+
+def _committed_block(match, mask):
+    """[R, T] masked quorum commit per lane column (support form)."""
+    r = match.shape[0]
+    n = jnp.sum(mask.astype(I32), axis=0, keepdims=True)  # [1, T]
+    q = n // 2 + 1
+    masked = jnp.where(mask, match, 0)
+    best = jnp.zeros_like(masked[:1])
+    for j in range(r):  # static unroll over the replica axis
+        c = masked[j : j + 1]  # [1, T]
+        support = jnp.sum(
+            (mask & (match >= c)).astype(I32), axis=0, keepdims=True
+        )
+        best = jnp.maximum(best, jnp.where(support >= q, c, 0))
+    return jnp.where(n == 0, MAX_I32, best)
+
+
+def _vote_block(votes, mask):
+    """[R, T] masked vote tally per lane column."""
+    n = jnp.sum(mask.astype(I32), axis=0, keepdims=True)
+    yes = jnp.sum((mask & (votes == 1)).astype(I32), axis=0, keepdims=True)
+    no = jnp.sum((mask & (votes == 0)).astype(I32), axis=0, keepdims=True)
+    missing = n - yes - no
+    q = n // 2 + 1
+    won = (yes >= q) | (n == 0)
+    pending = yes + missing >= q
+    return jnp.where(won, VOTE_WON, jnp.where(pending, VOTE_PENDING,
+                                              VOTE_LOST))
+
+
+def _quorum_kernel(match_ref, voter_ref, vout_ref, joint_ref, votes_ref,
+                   commit_ref, vres_ref):
+    match = match_ref[:]
+    voter = voter_ref[:] != 0
+    vout = vout_ref[:] != 0
+    joint = joint_ref[:] != 0  # [1, T]
+    votes = votes_ref[:]
+
+    cm = _committed_block(match, voter)
+    cj = jnp.minimum(cm, _committed_block(match, vout))
+    commit_ref[:] = jnp.where(joint, cj, cm)
+
+    a = _vote_block(votes, voter)
+    b = jnp.where(joint, _vote_block(votes, vout), VOTE_WON)
+    lost = (a == VOTE_LOST) | (b == VOTE_LOST)
+    pending = (a == VOTE_PENDING) | (b == VOTE_PENDING)
+    vres_ref[:] = jnp.where(lost, VOTE_LOST,
+                            jnp.where(pending, VOTE_PENDING, VOTE_WON))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quorum_commit_vote(match, voter, voter_out, in_joint, votes,
+                       interpret: bool = False):
+    """Fused joint commit index + vote result over [N, R] inputs.
+
+    match [N, R] i32; voter/voter_out [N, R] bool; in_joint [N] bool;
+    votes [N, R] i32 (-1 missing / 0 rejected / 1 granted).
+    Returns (commit [N] i32, vote_result [N] i32)."""
+    n, r = match.shape
+    # Lanes-minor layout: [R, N] so N fills the vector lanes.
+    mt = match.T.astype(I32)
+    vt = voter.T.astype(I32)
+    vo = voter_out.T.astype(I32)
+    jt = in_joint.reshape(1, n).astype(I32)
+    vs = votes.T.astype(I32)
+
+    grid = (pl.cdiv(n, _TILE_N),)
+    row_spec = pl.BlockSpec((r, _TILE_N), lambda i: (0, i))
+    one_spec = pl.BlockSpec((1, _TILE_N), lambda i: (0, i))
+    commit, vres = pl.pallas_call(
+        _quorum_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((1, n), I32),
+            jax.ShapeDtypeStruct((1, n), I32),
+        ),
+        grid=grid,
+        in_specs=[row_spec, row_spec, row_spec, one_spec, row_spec],
+        out_specs=(one_spec, one_spec),
+        interpret=interpret,
+    )(mt, vt, vo, jt, vs)
+    return commit[0], vres[0]
+
+
+def _term_kernel(log_ref, snapi_ref, snapt_ref, last_ref, idx_ref,
+                 out_ref):
+    log = log_ref[:]  # [W, T]
+    snapi = snapi_ref[:]  # [1, T]
+    snapt = snapt_ref[:]
+    last = last_ref[:]
+    idx = idx_ref[:]
+
+    w = log.shape[0]
+    rows = jax.lax.broadcasted_iota(I32, log.shape, 0)
+    im = jnp.where(idx >= 0, idx % w, 0)
+    ring_val = jnp.sum(jnp.where(rows == im, log, 0), axis=0,
+                       keepdims=True)
+    in_ring = (idx > snapi) & (idx <= last)
+    out_ref[:] = jnp.where(
+        idx == snapi, snapt, jnp.where(in_ring, ring_val, 0)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def term_at_batch(log_term, snap_index, snap_term, last, idx,
+                  interpret: bool = False):
+    """Ring term of entry ``idx[i]`` per instance, 0 outside
+    (snap_index, last] and snap_term at the floor itself.
+
+    log_term [N, W] i32; snap_index/snap_term/last/idx [N] i32.
+    Returns term [N] i32."""
+    n, w = log_term.shape
+    assert w <= 2048, "window larger than one VMEM block"
+    lt = log_term.T.astype(I32)  # [W, N]
+    row = lambda x: x.reshape(1, n).astype(I32)
+
+    grid = (pl.cdiv(n, _TILE_N),)
+    log_spec = pl.BlockSpec((w, _TILE_N), lambda i: (0, i))
+    one_spec = pl.BlockSpec((1, _TILE_N), lambda i: (0, i))
+    out = pl.pallas_call(
+        _term_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n), I32),
+        grid=grid,
+        in_specs=[log_spec, one_spec, one_spec, one_spec, one_spec],
+        out_specs=one_spec,
+        interpret=interpret,
+    )(lt, row(snap_index), row(snap_term), row(last), row(idx))
+    return out[0]

@@ -1,0 +1,100 @@
+"""Differential tests for the Pallas quorum/ring kernels against the
+XLA forms in kernels.py (interpret mode on CPU; the same kernels
+compile natively on TPU — see pallas_kernels.py and BENCH_NOTES.md for
+the integration gate)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from etcd_tpu.batched.kernels import (
+    MAX_I32,
+    joint_committed,
+    joint_vote_result,
+    term_at,
+)
+from etcd_tpu.batched.pallas_kernels import (
+    quorum_commit_vote,
+    term_at_batch,
+)
+
+
+@pytest.mark.parametrize("r", [1, 3, 5, 7])
+def test_quorum_commit_vote_matches_xla(r):
+    rng = np.random.RandomState(42 + r)
+    n = 700  # not a multiple of the tile: exercises grid padding
+    match = rng.randint(0, 50, size=(n, r)).astype(np.int32)
+    voter = rng.rand(n, r) < 0.8
+    voter_out = rng.rand(n, r) < 0.4
+    in_joint = rng.rand(n) < 0.5
+    votes = rng.randint(-1, 2, size=(n, r)).astype(np.int32)
+    # Include empty-config rows (the "commits everything" convention).
+    voter[0] = False
+    in_joint[0] = False
+    voter[1] = False
+    voter_out[1] = False
+    in_joint[1] = True
+
+    want_commit = jnp.stack([
+        joint_committed(
+            jnp.asarray(match[i]), jnp.asarray(voter[i]),
+            jnp.asarray(voter_out[i]), jnp.asarray(bool(in_joint[i])),
+        )
+        for i in range(64)
+    ])
+    want_vote = jnp.stack([
+        joint_vote_result(
+            jnp.asarray(votes[i]), jnp.asarray(voter[i]),
+            jnp.asarray(voter_out[i]), jnp.asarray(bool(in_joint[i])),
+        )
+        for i in range(64)
+    ])
+
+    commit, vres = quorum_commit_vote(
+        jnp.asarray(match), jnp.asarray(voter), jnp.asarray(voter_out),
+        jnp.asarray(in_joint), jnp.asarray(votes), interpret=True,
+    )
+    assert commit.shape == (n,) and vres.shape == (n,)
+    np.testing.assert_array_equal(np.asarray(commit[:64]),
+                                  np.asarray(want_commit))
+    np.testing.assert_array_equal(np.asarray(vres[:64]),
+                                  np.asarray(want_vote))
+
+
+def test_quorum_empty_config_commits_everything():
+    n, r = 8, 3
+    match = jnp.zeros((n, r), jnp.int32)
+    voter = jnp.zeros((n, r), bool)
+    commit, vres = quorum_commit_vote(
+        match, voter, voter, jnp.zeros((n,), bool),
+        jnp.full((n, r), -1, jnp.int32), interpret=True,
+    )
+    assert int(commit[0]) == int(MAX_I32)
+    assert (np.asarray(vres) == 3).all()  # VOTE_WON
+
+
+def test_term_at_batch_matches_xla():
+    rng = np.random.RandomState(7)
+    n, w = 600, 32
+    log = rng.randint(1, 9, size=(n, w)).astype(np.int32)
+    snap_index = rng.randint(0, 100, size=n).astype(np.int32)
+    snap_term = rng.randint(1, 9, size=n).astype(np.int32)
+    last = snap_index + rng.randint(0, w, size=n).astype(np.int32)
+    # Query below the floor, at the floor, inside, above last.
+    idx = (snap_index + rng.randint(-3, w + 3, size=n)).astype(np.int32)
+
+    want = jnp.stack([
+        term_at(
+            jnp.asarray(log[i]), jnp.asarray(snap_index[i]),
+            jnp.asarray(snap_term[i]), jnp.asarray(last[i]),
+            jnp.asarray(idx[i]),
+        )
+        for i in range(64)
+    ])
+    got = term_at_batch(
+        jnp.asarray(log), jnp.asarray(snap_index),
+        jnp.asarray(snap_term), jnp.asarray(last), jnp.asarray(idx),
+        interpret=True,
+    )
+    assert got.shape == (n,)
+    np.testing.assert_array_equal(np.asarray(got[:64]), np.asarray(want))
