@@ -353,6 +353,13 @@ class WriteTxn:
         at_rev = opts.rev if opts.rev > 0 else self.s.current_rev + (
             1 if self.changes else 0
         )
+        # Same revision bounds as the store-level read path (ref:
+        # kvstore_txn.go rangeKeys checks both on every txn read).
+        if opts.rev > 0:
+            if at_rev < self.s.compact_rev:
+                raise CompactedError()
+            if at_rev > self.s.current_rev + (1 if self.changes else 0):
+                raise FutureRevError()
         revs, total = self.s.index.revisions(key, end, at_rev, opts.limit)
         if opts.count_only:
             return RangeResult(kvs=[], rev=self.s.current_rev, count=total)

@@ -1,0 +1,367 @@
+"""mvcc KV contract table ports (ref: server/storage/mvcc/kv_test.go —
+the black-box functional suite: Range/RangeRev/RangeBadRev/RangeLimit,
+Put/Delete repetition, lease carry, operation sequences, txn blocking,
+compaction value retention, hash stability, restore equivalence), each
+run through both the store-level API and the write-txn API where the
+reference does."""
+
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.storage import backend as bk
+from etcd_tpu.storage.mvcc import (
+    CompactedError,
+    FutureRevError,
+    KVStore,
+    RangeOptions,
+)
+from etcd_tpu.storage.mvcc.kv import KeyValue
+
+
+def make_store(tmp_path, name="db"):
+    b = bk.Backend(str(tmp_path / f"{name}.sqlite"), batch_interval=10.0)
+    return b, KVStore(b)
+
+
+def put3(s):
+    """ref: kv_test.go:866 put3TestKVs."""
+    s.put(b"foo", b"bar", 1)
+    s.put(b"foo1", b"bar1", 2)
+    s.put(b"foo2", b"bar2", 3)
+    return [
+        KeyValue(key=b"foo", value=b"bar", create_revision=2,
+                 mod_revision=2, version=1, lease=1),
+        KeyValue(key=b"foo1", value=b"bar1", create_revision=3,
+                 mod_revision=3, version=1, lease=2),
+        KeyValue(key=b"foo2", value=b"bar2", create_revision=4,
+                 mod_revision=4, version=1, lease=3),
+    ]
+
+
+def store_range(s, key, end, **opts):
+    return s.range(key, end, RangeOptions(**opts))
+
+
+def txn_range(s, key, end, **opts):
+    with s.write() as tx:
+        return tx.range(key, end, RangeOptions(**opts))
+
+
+RANGE_FNS = [store_range, txn_range]
+
+
+@pytest.mark.parametrize("f", RANGE_FNS)
+def test_kv_range(tmp_path, f):
+    """ref: kv_test.go:78-141 testKVRange."""
+    _b, s = make_store(tmp_path)
+    kvs = put3(s)
+    wrev = 4
+    tests = [
+        (b"doo", b"foo", []),      # no keys
+        (b"foo", b"foo", []),      # key == end
+        (b"doo", None, []),        # missing single key
+        (b"foo", b"foo3", kvs),    # all keys
+        (b"foo", b"foo1", kvs[:1]),
+        (b"foo", None, kvs[:1]),   # single key
+        (b"", b"", kvs),           # entire keyspace
+    ]
+    for i, (key, end, wkvs) in enumerate(tests):
+        r = f(s, key, end)
+        assert r.rev == wrev, f"#{i}"
+        assert r.kvs == wkvs, f"#{i}"
+
+
+@pytest.mark.parametrize("f", RANGE_FNS)
+def test_kv_range_rev(tmp_path, f):
+    """ref: kv_test.go:143-176 testKVRangeRev."""
+    _b, s = make_store(tmp_path)
+    kvs = put3(s)
+    tests = [
+        (0, 4, kvs),
+        (2, 4, kvs[:1]),
+        (3, 4, kvs[:2]),
+        (4, 4, kvs),
+    ]
+    for i, (rev, wrev, wkvs) in enumerate(tests):
+        r = f(s, b"foo", b"foo3", rev=rev)
+        assert r.rev == wrev, f"#{i}"
+        assert r.kvs == wkvs, f"#{i}"
+
+
+@pytest.mark.parametrize("f", RANGE_FNS)
+def test_kv_range_bad_rev(tmp_path, f):
+    """ref: kv_test.go:178-209 testKVRangeBadRev."""
+    _b, s = make_store(tmp_path)
+    put3(s)
+    s.compact(4)
+    tests = [
+        (0, None),  # <= 0 means most recent
+        (1, CompactedError),
+        (2, CompactedError),
+        (4, None),
+        (5, FutureRevError),
+        (100, FutureRevError),
+    ]
+    for i, (rev, werr) in enumerate(tests):
+        if werr is None:
+            f(s, b"foo", b"foo3", rev=rev)
+        else:
+            with pytest.raises(werr):
+                f(s, b"foo", b"foo3", rev=rev)
+
+
+@pytest.mark.parametrize("f", RANGE_FNS)
+def test_kv_range_limit(tmp_path, f):
+    """ref: kv_test.go:211-253 testKVRangeLimit — limited ranges still
+    report the full count."""
+    _b, s = make_store(tmp_path)
+    kvs = put3(s)
+    wrev = 4
+    tests = [
+        (0, kvs),
+        (1, kvs[:1]),
+        (2, kvs[:2]),
+        (3, kvs),
+        (100, kvs),
+    ]
+    for i, (limit, wkvs) in enumerate(tests):
+        r = f(s, b"foo", b"foo3", limit=limit)
+        assert r.kvs == wkvs, f"#{i}"
+        assert r.rev == wrev, f"#{i}"
+        assert r.count == len(kvs), f"#{i}: count {r.count}"
+
+
+def test_kv_put_multiple_times(tmp_path):
+    """ref: kv_test.go:255-284 — version/lease/modrev march while
+    create_revision pins."""
+    _b, s = make_store(tmp_path)
+    for i in range(10):
+        base = i + 1
+        rev = s.put(b"foo", b"bar", base)
+        assert rev == base + 1
+        r = s.range(b"foo", None, RangeOptions())
+        assert r.kvs == [KeyValue(
+            key=b"foo", value=b"bar", create_revision=2,
+            mod_revision=base + 1, version=base, lease=base,
+        )], f"#{i}"
+
+
+def delete_store(s, key, end):
+    return s.delete_range(key, end)
+
+
+def delete_txn(s, key, end):
+    with s.write() as tx:
+        n = tx.delete_range(key, end)
+    return n, tx.rev
+
+
+@pytest.mark.parametrize("f", [delete_store, delete_txn])
+def test_kv_delete_range(tmp_path, f):
+    """ref: kv_test.go:286-332 testKVDeleteRange."""
+    tests = [
+        (b"foo", None, 5, 1),
+        (b"foo", b"foo1", 5, 1),
+        (b"foo", b"foo2", 5, 2),
+        (b"foo", b"foo3", 5, 3),
+        (b"foo3", b"foo8", 4, 0),
+        (b"foo3", None, 4, 0),
+    ]
+    for i, (key, end, wrev, wn) in enumerate(tests):
+        _b, s = make_store(tmp_path, name=f"db{f.__name__}{i}")
+        s.put(b"foo", b"bar", 0)
+        s.put(b"foo1", b"bar1", 0)
+        s.put(b"foo2", b"bar2", 0)
+        n, rev = f(s, key, end)
+        assert (n, rev) == (wn, wrev), f"#{i}"
+
+
+@pytest.mark.parametrize("f", [delete_store, delete_txn])
+def test_kv_delete_multiple_times(tmp_path, f):
+    """ref: kv_test.go:334-356 — deleting a tombstone is a no-op at
+    the same revision."""
+    _b, s = make_store(tmp_path)
+    s.put(b"foo", b"bar", 0)
+    n, rev = f(s, b"foo", None)
+    assert (n, rev) == (1, 3)
+    for i in range(10):
+        n, rev = f(s, b"foo", None)
+        assert (n, rev) == (0, 3), f"#{i}"
+
+
+def test_kv_put_with_same_lease(tmp_path):
+    """ref: kv_test.go:358-390."""
+    _b, s = make_store(tmp_path)
+    lease_id = 1
+    assert s.put(b"foo", b"bar", lease_id) == 2
+    assert s.put(b"foo", b"bar", lease_id) == 3
+    r = s.range(b"foo", None, RangeOptions())
+    assert r.kvs == [KeyValue(
+        key=b"foo", value=b"bar", create_revision=2, mod_revision=3,
+        version=2, lease=lease_id,
+    )]
+
+
+def test_kv_operation_in_sequence(tmp_path):
+    """ref: kv_test.go:393-444 — put/range/delete/range on one key,
+    repeatedly, with exact revision arithmetic."""
+    _b, s = make_store(tmp_path)
+    for i in range(10):
+        base = i * 2 + 1
+        rev = s.put(b"foo", b"bar", 0)
+        assert rev == base + 1, f"#{i}"
+        r = s.range(b"foo", None, RangeOptions(rev=base + 1))
+        assert r.kvs == [KeyValue(
+            key=b"foo", value=b"bar", create_revision=base + 1,
+            mod_revision=base + 1, version=1, lease=0,
+        )], f"#{i}"
+        assert r.rev == base + 1, f"#{i}"
+
+        n, rev = s.delete_range(b"foo", None)
+        assert (n, rev) == (1, base + 2), f"#{i}"
+        r = s.range(b"foo", None, RangeOptions(rev=base + 2))
+        assert r.kvs == [], f"#{i}"
+        assert r.rev == base + 2, f"#{i}"
+
+
+def test_kv_txn_block_write_operations(tmp_path):
+    """ref: kv_test.go:446-476 — store-level writes block while a
+    write txn is open and unblock at End."""
+    _b, s = make_store(tmp_path)
+    ops = [
+        lambda: s.put(b"foo", b"", 0),
+        lambda: s.delete_range(b"foo", None),
+    ]
+    for i, op in enumerate(ops):
+        tx = s.write()
+        tx.__enter__()
+        done = threading.Event()
+
+        def run(op=op):
+            op()
+            done.set()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert not done.wait(0.05), f"#{i}: op not blocked by txn"
+        tx.__exit__(None, None, None)
+        assert done.wait(10.0), f"#{i}: op not unblocked after End"
+        t.join(timeout=5)
+
+
+def test_kv_txn_operation_in_sequence(tmp_path):
+    """ref: kv_test.go:499-556 — the txn's own writes are visible at
+    current_rev+1 inside the txn; delete in the same txn shares the
+    main revision. NB: the reference's txn.Put returns the revision;
+    this port reads it from the txn's pending revision."""
+    _b, s = make_store(tmp_path)
+    for i in range(10):
+        base = i + 1
+        with s.write() as tx:
+            tx.put(b"foo", b"bar", 0)
+            r = tx.range(b"foo", None, RangeOptions(rev=base + 1))
+            assert r.kvs == [KeyValue(
+                key=b"foo", value=b"bar", create_revision=base + 1,
+                mod_revision=base + 1, version=1, lease=0,
+            )], f"#{i}"
+            n = tx.delete_range(b"foo", None)
+            assert n == 1, f"#{i}"
+            r = tx.range(b"foo", None, RangeOptions(rev=base + 1))
+            assert r.kvs == [], f"#{i}"
+        assert tx.rev == base + 1, f"#{i}"
+
+
+def test_kv_compact_reserve_last_value(tmp_path):
+    """ref: kv_test.go:558-602 — compaction keeps the latest value at
+    or before the compact revision; a tombstoned generation vanishes."""
+    _b, s = make_store(tmp_path)
+    s.put(b"foo", b"bar0", 1)
+    s.put(b"foo", b"bar1", 2)
+    s.delete_range(b"foo", None)
+    s.put(b"foo", b"bar2", 3)
+
+    tests = [
+        (1, [KeyValue(key=b"foo", value=b"bar0", create_revision=2,
+                      mod_revision=2, version=1, lease=1)]),
+        (2, [KeyValue(key=b"foo", value=b"bar1", create_revision=2,
+                      mod_revision=3, version=2, lease=2)]),
+        (3, []),
+        (4, [KeyValue(key=b"foo", value=b"bar2", create_revision=5,
+                      mod_revision=5, version=1, lease=3)]),
+    ]
+    for i, (rev, wkvs) in enumerate(tests):
+        s.compact(rev)
+        r = s.range(b"foo", None, RangeOptions(rev=rev + 1))
+        assert r.kvs == wkvs, f"#{i}"
+
+
+def test_kv_compact_bad(tmp_path):
+    """ref: kv_test.go:604-636 testKVCompactBad. The reference accepts
+    compact(0) as a no-op (its floor starts at -1); this store's floor
+    starts at 0, so compact(0) reports already-compacted — same
+    observable state, stricter error."""
+    _b, s = make_store(tmp_path)
+    s.put(b"foo", b"bar0", 0)
+    s.put(b"foo", b"bar1", 0)
+    s.put(b"foo", b"bar2", 0)
+    tests = [
+        (0, CompactedError),
+        (1, None),
+        (1, CompactedError),
+        (4, None),
+        (5, FutureRevError),
+        (100, FutureRevError),
+    ]
+    for i, (rev, werr) in enumerate(tests):
+        if werr is None:
+            s.compact(rev)
+        else:
+            with pytest.raises(werr):
+                s.compact(rev)
+
+
+def test_kv_hash_deterministic(tmp_path):
+    """ref: kv_test.go:638-660 TestKVHash — identical content hashes
+    identically across independent stores."""
+    hashes = []
+    for i in range(3):
+        _b, s = make_store(tmp_path, name=f"h{i}")
+        s.put(b"foo0", b"bar0", 0)
+        s.put(b"foo1", b"bar0", 0)
+        h, _cur, _comp = s.hash_kv()
+        hashes.append(h)
+    assert hashes[0] == hashes[1] == hashes[2]
+
+
+def test_kv_restore(tmp_path):
+    """ref: kv_test.go:662-714 TestKVRestore — a store reopened over
+    the same backend answers every historical range identically."""
+    scenarios = [
+        lambda s: (s.put(b"foo", b"bar0", 1), s.put(b"foo", b"bar1", 2),
+                   s.put(b"foo", b"bar2", 3), s.put(b"foo2", b"bar0", 1)),
+        lambda s: (s.put(b"foo", b"bar0", 1), s.delete_range(b"foo", None),
+                   s.put(b"foo", b"bar1", 2)),
+        lambda s: (s.put(b"foo", b"bar0", 1), s.put(b"foo", b"bar1", 2),
+                   s.compact(1)),
+    ]
+    for i, scenario in enumerate(scenarios):
+        b = bk.Backend(str(tmp_path / f"r{i}.sqlite"), batch_interval=10.0)
+        s = KVStore(b)
+        scenario(s)
+
+        def ranges(store):
+            out = []
+            for k in range(10):
+                try:
+                    r = store.range(b"a", b"z", RangeOptions(rev=k))
+                    out.append(r.kvs)
+                except (CompactedError, FutureRevError) as e:
+                    out.append(type(e).__name__)
+            return out
+
+        before = ranges(s)
+        b.force_commit()
+        ns = KVStore(b)
+        assert ranges(ns) == before, f"#{i}"
