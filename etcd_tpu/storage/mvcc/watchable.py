@@ -348,6 +348,14 @@ class WatchableStore(KVStore):
         return evs
 
 
+class WatcherDuplicateIDError(Exception):
+    """ref: mvcc.ErrWatcherDuplicateID."""
+
+
+class EmptyWatcherRangeError(Exception):
+    """ref: mvcc.ErrEmptyWatcherRange — key >= end describes no keys."""
+
+
 class WatchStream:
     """Client-facing handle multiplexing many watchers onto one queue
     (ref: mvcc/watcher.go:108 watchStream)."""
@@ -358,6 +366,7 @@ class WatchStream:
         self._cond = threading.Condition(self._lock)
         self._q: Deque[WatchResponse] = deque()
         self._watchers: Dict[int, Watcher] = {}
+        self._next_id = 0  # per-stream auto ids (ref: watcher.go Watch)
         self._closed = False
         mmet.watch_stream_total.inc()
 
@@ -375,6 +384,21 @@ class WatchStream:
     def watch(self, key: bytes, end: Optional[bytes] = None,
               start_rev: int = 0, wid: Optional[int] = None,
               fcs=None) -> int:
+        """end semantics: None = single key; b"" = open-ended (every
+        key >= key); otherwise end must sort above key
+        (ref: watcher.go:108-136 Watch)."""
+        if end is not None and end != b"" and end <= key:
+            raise EmptyWatcherRangeError()
+        with self._lock:
+            if wid is not None:
+                if wid in self._watchers:
+                    raise WatcherDuplicateIDError()
+            else:
+                # Per-stream auto assignment skips manually-taken ids.
+                while self._next_id in self._watchers:
+                    self._next_id += 1
+                wid = self._next_id
+                self._next_id += 1
         w = self._s.watch(key, end, start_rev, self, wid=wid, fcs=fcs)
         with self._lock:
             self._watchers[w.id] = w

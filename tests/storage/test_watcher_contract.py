@@ -1,0 +1,160 @@
+"""WatchStream contract ports (ref: server/storage/mvcc/
+watcher_test.go: WatchID allocation, custom-ID duplicates, prefix
+matching, wrong ranges, delete-range events, cancel by ID, progress
+requests, filters)."""
+
+import pytest
+
+from etcd_tpu.storage import backend as bk
+from etcd_tpu.storage.mvcc.kv import EventType
+from etcd_tpu.storage.mvcc.watchable import (
+    EmptyWatcherRangeError,
+    WatchableStore,
+    WatcherDuplicateIDError,
+)
+
+
+def make_store(tmp_path, name="db"):
+    b = bk.Backend(str(tmp_path / f"{name}.sqlite"), batch_interval=10.0)
+    return b, WatchableStore(b)
+
+
+def test_watcher_watch_id(tmp_path):
+    """ref: watcher_test.go:33-81 — ids are unique per stream, events
+    carry the right id, for both synced and unsynced watchers."""
+    _b, s = make_store(tmp_path)
+    w = s.new_watch_stream()
+    ids = set()
+    for i in range(10):
+        wid = w.watch(b"foo")
+        assert wid not in ids, f"#{i}"
+        ids.add(wid)
+        s.put(b"foo", b"bar", 0)
+        resp = w.poll(timeout=5.0)
+        assert resp is not None and resp.watch_id == wid, f"#{i}"
+        assert w.cancel(wid), f"#{i}"
+
+    s.put(b"foo2", b"bar", 0)
+    # Unsynced watchers (start_rev=1) get ids and replay events too.
+    for i in range(10, 20):
+        wid = w.watch(b"foo2", start_rev=1)
+        assert wid not in ids, f"#{i}"
+        ids.add(wid)
+        s.sync_watchers()
+        resp = w.poll(timeout=5.0)
+        assert resp is not None and resp.watch_id == wid, f"#{i}"
+        assert w.cancel(wid), f"#{i}"
+    w.close()
+
+
+def test_watcher_requests_custom_id(tmp_path):
+    """ref: watcher_test.go:83-118 — duplicate custom ids error; auto
+    assignment skips manually-taken ids."""
+    _b, s = make_store(tmp_path)
+    w = s.new_watch_stream()
+    assert w.watch(b"foo", wid=1) == 1
+    with pytest.raises(WatcherDuplicateIDError):
+        w.watch(b"foo", wid=1)
+    assert w.watch(b"foo") == 0
+    assert w.watch(b"foo") == 2  # skips the manually-assigned 1
+    w.close()
+
+
+def test_watcher_watch_prefix(tmp_path):
+    """ref: watcher_test.go:120-192 (core) — a range watch sees only
+    keys under the prefix."""
+    _b, s = make_store(tmp_path)
+    w = s.new_watch_stream()
+    wid = w.watch(b"foo", end=b"fop")
+    s.put(b"foobar", b"v", 0)
+    resp = w.poll(timeout=5.0)
+    assert resp is not None and resp.watch_id == wid
+    assert resp.events[0].kv.key == b"foobar"
+    s.put(b"zoo", b"v", 0)  # outside the prefix: no event
+    assert w.poll(timeout=0.1) is None
+    w.close()
+
+
+def test_watcher_watch_wrong_range(tmp_path):
+    """ref: watcher_test.go:194-212."""
+    _b, s = make_store(tmp_path)
+    w = s.new_watch_stream()
+    with pytest.raises(EmptyWatcherRangeError):
+        w.watch(b"foa", end=b"foa", start_rev=1)  # key == end
+    with pytest.raises(EmptyWatcherRangeError):
+        w.watch(b"fob", end=b"foa", start_rev=1)  # key > end
+    # Open-ended (FromKey) watch: empty-bytes end is legal, id 0.
+    assert w.watch(b"foo", end=b"", start_rev=1) == 0
+    w.close()
+
+
+def test_watch_delete_range(tmp_path):
+    """ref: watcher_test.go:214-252 — one response carries every
+    delete in the range, all at the same revision."""
+    _b, s = make_store(tmp_path)
+    for i in range(3):
+        s.put(b"foo_%d" % i, b"bar", 0)
+    w = s.new_watch_stream()
+    w.watch(b"foo", end=b"foo_99")
+    s.delete_range(b"foo", b"foo_99")
+    resp = w.poll(timeout=5.0)
+    assert resp is not None
+    got = [(e.type, e.kv.key, e.kv.mod_revision) for e in resp.events]
+    assert got == [
+        (EventType.DELETE, b"foo_0", 5),
+        (EventType.DELETE, b"foo_1", 5),
+        (EventType.DELETE, b"foo_2", 5),
+    ]
+    w.close()
+
+
+def test_watch_stream_cancel_watcher_by_id(tmp_path):
+    """ref: watcher_test.go:254-289 — cancel detaches exactly the
+    given id; double-cancel and unknown ids report failure."""
+    _b, s = make_store(tmp_path)
+    w = s.new_watch_stream()
+    wid = w.watch(b"foo")
+    assert w.cancel(wid)
+    assert not w.cancel(wid)
+    assert not w.cancel(999)
+    s.put(b"foo", b"bar", 0)
+    assert w.poll(timeout=0.1) is None  # canceled: no events
+    w.close()
+
+
+def test_watcher_request_progress(tmp_path):
+    """ref: watcher_test.go:291-344 — progress is only reported for a
+    SYNCED watcher, and carries the current revision."""
+    _b, s = make_store(tmp_path)
+    s.put(b"foo", b"bar", 0)
+    w = s.new_watch_stream()
+
+    w.request_progress(1000)  # unknown id: nothing
+    assert w.poll(timeout=0.05) is None
+
+    wid = w.watch(b"bad", start_rev=1)  # unsynced until sync runs
+    w.request_progress(wid)
+    assert w.poll(timeout=0.05) is None
+
+    s.sync_watchers()
+    w.request_progress(wid)
+    resp = w.poll(timeout=5.0)
+    assert resp is not None
+    assert resp.watch_id == wid and resp.events == []
+    assert resp.revision == 2
+    w.close()
+
+
+def test_watcher_watch_with_filter(tmp_path):
+    """ref: watcher_test.go:346-398 — a PUT filter suppresses put
+    events but passes deletes."""
+    _b, s = make_store(tmp_path)
+    w = s.new_watch_stream()
+    w.watch(b"foo", fcs=[lambda ev: ev.type == EventType.PUT])
+    s.put(b"foo", b"bar", 0)
+    assert w.poll(timeout=0.1) is None  # filtered
+    s.delete_range(b"foo", None)
+    resp = w.poll(timeout=5.0)
+    assert resp is not None
+    assert [e.type for e in resp.events] == [EventType.DELETE]
+    w.close()
