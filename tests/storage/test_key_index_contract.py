@@ -1,0 +1,226 @@
+"""keyIndex structural contract ports (ref: server/storage/mvcc/
+key_index_test.go: Put/Restore/Tombstone shapes, the Get table over
+the canonical three-generation fixture, compact-vs-keep agreement,
+IsEmpty/FindGeneration/Generation helpers)."""
+
+import pytest
+
+from etcd_tpu.storage.mvcc import KeyIndex, Revision
+from etcd_tpu.storage.mvcc.key_index import Generation, RevisionNotFound
+
+
+def new_test_key_index():
+    """ref: key_index_test.go:681-701 — three finished generations:
+    {2,4,6t} {8,10,12t} {14,(14,1),16t} + trailing empty."""
+    ki = KeyIndex(key=b"foo")
+    ki.put(2, 0)
+    ki.put(4, 0)
+    ki.tombstone(6, 0)
+    ki.put(8, 0)
+    ki.put(10, 0)
+    ki.tombstone(12, 0)
+    ki.put(14, 0)
+    ki.put(14, 1)
+    ki.tombstone(16, 0)
+    return ki
+
+
+def gens(ki):
+    return [
+        (g.created, g.version, [ (r.main, r.sub) for r in g.revs ])
+        for g in ki.generations
+    ]
+
+
+def test_key_index_put():
+    """ref: key_index_test.go:128-152."""
+    ki = KeyIndex(key=b"foo")
+    ki.put(5, 0)
+    assert ki.modified == Revision(5, 0)
+    assert gens(ki) == [(Revision(5, 0), 1, [(5, 0)])]
+    ki.put(7, 0)
+    assert ki.modified == Revision(7, 0)
+    assert gens(ki) == [(Revision(5, 0), 2, [(5, 0), (7, 0)])]
+    # Regressing revisions are refused (the reference panics).
+    with pytest.raises(Exception):
+        ki.put(6, 0)
+
+
+def test_key_index_restore():
+    """ref: key_index_test.go:153-166 — a restored index carries the
+    stored created/version but only the latest revision."""
+    ki = KeyIndex(key=b"foo")
+    ki.restore(Revision(5, 0), Revision(7, 0), 2)
+    assert ki.modified == Revision(7, 0)
+    assert gens(ki) == [(Revision(5, 0), 2, [(7, 0)])]
+
+
+def test_key_index_tombstone():
+    """ref: key_index_test.go:167-209."""
+    ki = KeyIndex(key=b"foo")
+    ki.put(5, 0)
+    ki.tombstone(7, 0)
+    assert ki.modified == Revision(7, 0)
+    assert gens(ki) == [
+        (Revision(5, 0), 2, [(5, 0), (7, 0)]),
+        (Revision(0, 0), 0, []),
+    ]
+
+    ki.put(8, 0)
+    ki.put(9, 0)
+    ki.tombstone(15, 0)
+    assert ki.modified == Revision(15, 0)
+    assert gens(ki) == [
+        (Revision(5, 0), 2, [(5, 0), (7, 0)]),
+        (Revision(8, 0), 3, [(8, 0), (9, 0), (15, 0)]),
+        (Revision(0, 0), 0, []),
+    ]
+
+    # Tombstoning an already-tombstoned key reports not-found.
+    with pytest.raises(RevisionNotFound):
+        ki.tombstone(16, 0)
+
+
+def test_key_index_get_table():
+    """ref: key_index_test.go:43-107 — the full visibility table over
+    the fixture after compact(4)."""
+    ki = new_test_key_index()
+    ki.compact(4, {})
+
+    tests = [
+        (17, None, None, 0, True),
+        (16, None, None, 0, True),
+        (15, Revision(14, 1), Revision(14, 0), 2, False),
+        (14, Revision(14, 1), Revision(14, 0), 2, False),
+        (13, None, None, 0, True),
+        (12, None, None, 0, True),
+        (11, Revision(10, 0), Revision(8, 0), 2, False),
+        (10, Revision(10, 0), Revision(8, 0), 2, False),
+        (9, Revision(8, 0), Revision(8, 0), 1, False),
+        (8, Revision(8, 0), Revision(8, 0), 1, False),
+        (7, None, None, 0, True),
+        (6, None, None, 0, True),
+        (5, Revision(4, 0), Revision(2, 0), 2, False),
+        (4, Revision(4, 0), Revision(2, 0), 2, False),
+        (3, None, None, 0, True),
+        (2, None, None, 0, True),
+        (1, None, None, 0, True),
+        (0, None, None, 0, True),
+    ]
+    for i, (rev, wmod, wcreat, wver, werr) in enumerate(tests):
+        if werr:
+            with pytest.raises(RevisionNotFound):
+                ki.get(rev)
+        else:
+            mod, creat, ver = ki.get(rev)
+            assert (mod, creat, ver) == (wmod, wcreat, wver), f"#{i}"
+
+
+def test_key_index_since_table():
+    """ref: key_index_test.go:109-127 (post-compact(4) slice)."""
+    ki = new_test_key_index()
+    ki.compact(4, {})
+    all_revs = [Revision(4, 0), Revision(6, 0), Revision(8, 0),
+                Revision(10, 0), Revision(12, 0), Revision(14, 1),
+                Revision(16, 0)]
+    tests = [
+        (17, []),
+        (16, all_revs[6:]),
+        (15, all_revs[6:]),
+        (14, all_revs[5:]),
+        (13, all_revs[5:]),
+        (12, all_revs[4:]),
+        (9, all_revs[3:]),
+        (4, all_revs[0:]),
+        (0, all_revs[0:]),
+    ]
+    for i, (rev, wrevs) in enumerate(tests):
+        assert ki.since(rev) == wrevs, f"#{i}"
+
+
+@pytest.mark.parametrize("at_rev", range(1, 17))
+def test_key_index_compact_matches_keep(at_rev):
+    """ref: key_index_test.go:211-557 TestKeyIndexCompactAndKeep — the
+    non-mutating keep probe (via _doompoint) and an actual compact on a
+    fresh fixture mark the same available set."""
+    probe = {}
+    ki1 = new_test_key_index()
+    ki1._doompoint(at_rev, probe)
+
+    avail = {}
+    ki2 = new_test_key_index()
+    ki2.compact(at_rev, avail)
+    assert probe == avail, f"keep {probe} != compact {avail}"
+
+    # Compacting the same index incrementally up to at_rev gives the
+    # same structure as one compact (idempotence over steps).
+    ki3 = new_test_key_index()
+    for r in range(1, at_rev + 1):
+        ki3.compact(r, {})
+    assert gens(ki2) == gens(ki3)
+
+
+def test_key_index_is_empty():
+    """ref: key_index_test.go:559-588."""
+    ki = KeyIndex(key=b"foo")
+    assert ki.is_empty()
+    ki.put(2, 0)
+    assert not ki.is_empty()
+    ki.tombstone(3, 0)
+    assert not ki.is_empty()  # finished generation still present
+    ki.compact(3, {})
+    assert ki.is_empty()  # tombstoned + compacted: nothing left
+
+
+def test_key_index_find_generation():
+    """ref: key_index_test.go:590-618 — generation lookup over the
+    two-generation shape {2,4,6t}{8,10,12t}."""
+    ki = KeyIndex(key=b"foo")
+    ki.put(2, 0)
+    ki.put(4, 0)
+    ki.tombstone(6, 0)
+    ki.put(8, 0)
+    ki.put(10, 0)
+    ki.tombstone(12, 0)
+
+    g0, g1 = ki.generations[0], ki.generations[1]
+    tests = [
+        (0, None),
+        (1, None),
+        (2, g0),
+        (4, g0),
+        (5, g0),   # deleted at 6, still visible at 5
+        (6, None),
+        (7, None),
+        (8, g1),
+        (10, g1),
+        (11, g1),
+        (12, None),
+        (13, None),
+    ]
+    for i, (rev, want) in enumerate(tests):
+        assert ki._find_generation(rev) is want, f"#{i} rev={rev}"
+
+
+def test_generation_is_empty():
+    """ref: key_index_test.go:639-654."""
+    assert Generation().is_empty()
+    assert not Generation(version=1, created=Revision(1, 0),
+                          revs=[Revision(1, 0)]).is_empty()
+
+
+def test_generation_walk():
+    """ref: key_index_test.go:656-679 — walk newest-first, returning
+    the index of the first rev failing the predicate."""
+    g = Generation(version=3, created=Revision(2, 0),
+                   revs=[Revision(2, 0), Revision(4, 0), Revision(6, 0)])
+    tests = [
+        (lambda rev: rev.main >= 7, 2),
+        (lambda rev: rev.main >= 6, 1),
+        (lambda rev: rev.main >= 5, 1),
+        (lambda rev: rev.main >= 4, 0),
+        (lambda rev: rev.main >= 3, 0),
+        (lambda rev: rev.main >= 2, -1),
+    ]
+    for i, (pred, want) in enumerate(tests):
+        assert g.walk(pred) == want, f"#{i}"
