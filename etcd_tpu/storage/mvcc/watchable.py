@@ -154,6 +154,9 @@ class WatchableStore(KVStore):
         self._victims: List[Tuple[Watcher, List[Event]]] = []
         self._buffer_cap = buffer_cap
         self._next_watch_id = 0
+        # Max distinct revisions per unsynced replay response
+        # (ref: watchable_store.go watchBatchMaxRevs = 1000).
+        self.watch_batch_max_revs = 1000
         super().__init__(backend, lessor)
 
     # -- KVStore write hook ----------------------------------------------------
@@ -182,7 +185,10 @@ class WatchableStore(KVStore):
             w = Watcher(wid, key, end, start_rev, fcs or [], sink)
             cur = self.rev()
             if start_rev == 0 or start_rev > cur:
-                w.min_rev = cur + 1
+                # A future-rev watcher is synced but keeps its start
+                # revision: notify must not hand it events below it
+                # (ref: watchable_store.go:128-136).
+                w.min_rev = max(cur + 1, start_rev)
                 self.synced.add(w)
             else:
                 self.unsynced.add(w)
@@ -213,6 +219,10 @@ class WatchableStore(KVStore):
             per_w: Dict[Watcher, List[Event]] = {}
             for ev in events:
                 for w in self.synced.matching(ev.kv.key):
+                    # Future-rev watchers wait for their start revision
+                    # (ref: watcher_group.go newWatcherBatch minRev gate).
+                    if ev.kv.mod_revision < w.min_rev:
+                        continue
                     per_w.setdefault(w, []).append(ev)
             for w, evs in per_w.items():
                 ok = w.send(WatchResponse(w.id, evs, rev))
@@ -254,15 +264,35 @@ class WatchableStore(KVStore):
                     e for e in evs
                     if e.kv.mod_revision >= w.min_rev and self._match(w, e)
                 ]
+                # Cap one replay response to WATCH_BATCH_MAX_REVS
+                # distinct revisions; a capped watcher stays unsynced
+                # with min_rev at the first undelivered revision
+                # (ref: watchable_store.go watchBatchMaxRevs +
+                # watcher_group.go newWatcherBatch moreRev).
+                more_rev = 0
+                if mine:
+                    distinct, last, cut = 0, -1, len(mine)
+                    for i, e in enumerate(mine):
+                        r = e.kv.mod_revision
+                        if r != last:
+                            distinct += 1
+                            last = r
+                            if distinct > self.watch_batch_max_revs:
+                                cut, more_rev = i, r
+                                break
+                    mine = mine[:cut]
                 if mine and not w.send(
                         WatchResponse(w.id, mine, cur)):
                     w.victim = True
-                    w.min_rev = cur + 1
+                    w.min_rev = more_rev or cur + 1
                     self.unsynced.remove(w)
                     self._victims.append((w, mine))
                     continue
                 if mine:
                     mmet.events_total.inc(len(mine))
+                if more_rev:
+                    w.min_rev = more_rev  # stay unsynced; next pass
+                    continue
                 w.min_rev = cur + 1
                 self.unsynced.remove(w)
                 self.synced.add(w)

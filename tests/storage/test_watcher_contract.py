@@ -158,3 +158,123 @@ def test_watcher_watch_with_filter(tmp_path):
     assert resp is not None
     assert [e.type for e in resp.events] == [EventType.DELETE]
     w.close()
+
+
+def test_cancel_unsynced(tmp_path):
+    """ref: watchable_store_test.go:82-136 — canceling unsynced
+    watchers empties the unsynced group."""
+    _b, s = make_store(tmp_path)
+    s.put(b"foo", b"bar", 0)
+    w = s.new_watch_stream()
+    wids = [w.watch(b"foo", start_rev=1) for _ in range(100)]
+    assert len(s.unsynced) == 100
+    for wid in wids:
+        assert w.cancel(wid)
+    assert len(s.unsynced) == 0
+    w.close()
+
+
+def test_sync_watchers_moves_to_synced(tmp_path):
+    """ref: watchable_store_test.go:141-224 — syncWatchers delivers
+    the replay events and moves every watcher to synced."""
+    _b, s = make_store(tmp_path)
+    s.put(b"foo", b"bar", 0)
+    w = s.new_watch_stream()
+    n = 100
+    for _ in range(n):
+        w.watch(b"foo", start_rev=1)
+    assert len(s.unsynced) == n and len(s.synced) == 0
+
+    s.sync_watchers()
+    assert len(s.unsynced) == 0 and len(s.synced) == n
+
+    got = 0
+    while True:
+        resp = w.poll(timeout=0.2)
+        if resp is None:
+            break
+        assert len(resp.events) == 1
+        assert resp.events[0].kv.key == b"foo"
+        got += 1
+    assert got == n
+    w.close()
+
+
+def test_watch_future_rev(tmp_path):
+    """ref: watchable_store_test.go:263-301 — a future-rev watcher
+    stays silent until the store reaches that revision, then delivers
+    exactly the event at it."""
+    _b, s = make_store(tmp_path)
+    w = s.new_watch_stream()
+    wrev = 10
+    w.watch(b"foo", start_rev=wrev)
+    while True:
+        rev = s.put(b"foo", b"bar", 0)
+        if rev >= wrev:
+            break
+    resp = w.poll(timeout=5.0)
+    assert resp is not None
+    assert resp.revision == wrev
+    assert len(resp.events) == 1
+    assert resp.events[0].kv.mod_revision == wrev
+    w.close()
+
+
+def test_watch_batch_unsynced(tmp_path):
+    """ref: watchable_store_test.go:402-433 — unsynced replay arrives
+    in batches of at most watch_batch_max_revs revisions, then the
+    watcher lands in synced."""
+    _b, s = make_store(tmp_path)
+    batches, batch_revs = 3, 4
+    s.watch_batch_max_revs = batch_revs
+    for _ in range(batches * batch_revs):
+        s.put(b"foo", b"foo", 0)
+    w = s.new_watch_stream()
+    w.watch(b"foo", start_rev=1)
+    for i in range(batches):
+        while s.sync_watchers() and w.pending() == 0:
+            pass
+        resp = w.poll(timeout=5.0)
+        assert resp is not None, f"batch {i}"
+        assert len(resp.events) == batch_revs, f"batch {i}"
+    s.sync_watchers()
+    assert len(s.synced) == 1 and len(s.unsynced) == 0
+    w.close()
+
+
+def test_stress_watch_cancel_close(tmp_path):
+    """ref: watchable_store_test.go:615-659 — concurrent watch/cancel/
+    close across 100 streams while writes flow must not deadlock or
+    corrupt the groups."""
+    import threading
+
+    _b, s = make_store(tmp_path)
+    readyc = threading.Event()
+    errors = []
+
+    def stream_worker():
+        try:
+            w = s.new_watch_stream()
+            ids = [w.watch(b"foo") for _ in range(10)]
+            readyc.wait()
+            ts = [
+                threading.Thread(target=w.cancel, args=(wid,))
+                for wid in ids[: len(ids) // 2]
+            ] + [threading.Thread(target=w.close)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    workers = [threading.Thread(target=stream_worker) for _ in range(100)]
+    for t in workers:
+        t.start()
+    readyc.set()
+    for _ in range(100):
+        s.put(b"foo", b"bar", 0)
+    for t in workers:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in workers), "deadlocked stream worker"
+    assert not errors, errors[:3]
