@@ -327,28 +327,36 @@ class Lessor:
                 self.expired_queue.push(lease.id, lease.expiry())
                 if self._should_checkpoint(lease):
                     self._schedule_checkpoint(lease)
-            if len(leases) <= LEASE_REVOKE_RATE * self.loop_interval * 2:
-                return
-            # Spread a thundering herd of expiries (lessor.go:491-529):
-            # limit to revoke-rate per second past the base window.
+            if len(leases) < LEASE_REVOKE_RATE:
+                return  # no possibility of lease pile-up
+            # Spread a thundering herd of expiries over 1-second
+            # windows at 3/4 of the revoke rate, exactly the
+            # reference's shape (lessor.go:484-517): piled-up leases
+            # must not consume the entire revoke limit.
             leases.sort(key=lambda l: l.remaining())
-            base_window = leases[0].remaining() if leases else 0.0
-            next_window = base_window + self.loop_interval
-            expires_in_window = 0
-            rate_per_window = int(LEASE_REVOKE_RATE * self.loop_interval)
+            base_window = leases[0].remaining()
+            next_window = base_window + 1.0
+            expires = 0
+            target_per_second = (3 * LEASE_REVOKE_RATE) // 4
             for lease in leases:
                 rem = lease.remaining()
                 if rem > next_window:
                     base_window = rem
-                    next_window = base_window + self.loop_interval
-                    expires_in_window = 1
+                    next_window = base_window + 1.0
+                    expires = 1
                     continue
-                expires_in_window += 1
-                if expires_in_window > rate_per_window:
-                    delay = next_window - rem
-                    with lease._expiry_lock:
-                        lease._expiry += delay
-                    self.expired_queue.push(lease.id, lease.expiry())
+                expires += 1
+                if expires <= target_per_second:
+                    continue
+                rate_delay = 1.0 * (expires / target_per_second)
+                # Leases n seconds past the base window only need the
+                # difference to land in their spread slot.
+                rate_delay -= rem - base_window
+                next_window = base_window + rate_delay
+                lease.refresh(rate_delay + extend)
+                self.expired_queue.push(lease.id, lease.expiry())
+                if self._should_checkpoint(lease):
+                    self._schedule_checkpoint(lease)
 
     def demote(self) -> None:
         """ref: lessor.go:558-563 + runLoop demotec handling."""

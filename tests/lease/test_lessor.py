@@ -199,3 +199,80 @@ class TestPersistence:
         le2 = new_lessor(be, checkpoint_persist=True)
         assert le2.lookup(1).remaining_ttl == 25
         le2.stop()
+
+
+def test_lease_concurrent_keys(be):
+    """ref: lessor_test.go:108-151 — Keys() races Detach without
+    deadlock or corruption."""
+    import threading
+
+    le = new_lessor(be)
+    try:
+        lease = le.grant(1, 100)
+        items = [LeaseItem(key=f"foo{i}") for i in range(10)]
+        le.attach(lease.id, items)
+
+        done = threading.Event()
+
+        def detach():
+            le.detach(lease.id, items)
+            done.set()
+
+        readers = [
+            threading.Thread(target=lease.keys) for _ in range(10)
+        ]
+        t = threading.Thread(target=detach)
+        t.start()
+        for r in readers:
+            r.start()
+        assert done.wait(10.0)
+        for r in readers:
+            r.join(timeout=10.0)
+        assert not any(r.is_alive() for r in readers)
+        assert lease.keys() == []
+    finally:
+        le.stop()
+
+
+def test_lessor_max_ttl(be):
+    """ref: lessor_test.go:515-528."""
+    from etcd_tpu.lease.lessor import MAX_TTL, LeaseTTLTooLargeError
+
+    le = new_lessor(be)
+    try:
+        with pytest.raises(LeaseTTLTooLargeError):
+            le.grant(1, MAX_TTL + 1)
+    finally:
+        le.stop()
+
+
+def test_lessor_renew_extend_pileup(be, tmp_path, monkeypatch):
+    """ref: lessor_test.go:290-337 — after recovery+promote, piled-up
+    leases spread so no 1-second window holds more than the revoke
+    rate."""
+    from etcd_tpu.lease import lessor as lessor_mod
+
+    monkeypatch.setattr(lessor_mod, "LEASE_REVOKE_RATE", 10)
+    rate = 10
+    ttl = 10
+    le = new_lessor(be)
+    for i in range(1, rate * 10 + 1):
+        le.grant(2 * i, ttl)
+        le.grant(2 * i + 1, ttl + 1)  # ttls that overlap spillover
+    # Simulate stop and recovery over the same backend.
+    le.stop()
+    le2 = new_lessor(be)
+    try:
+        le2.promote(0.0)
+        window_counts = {}
+        for lease in le2.lease_map.values():
+            s = int(lease.remaining() + 0.1)
+            window_counts[s] = window_counts.get(s, 0) + 1
+        for sec in range(ttl, ttl + 20):
+            c = window_counts.get(sec, 0)
+            assert c <= rate, (
+                f"expected at most {rate} expiring at {sec}s, got {c}: "
+                f"{sorted(window_counts.items())}"
+            )
+    finally:
+        le2.stop()
