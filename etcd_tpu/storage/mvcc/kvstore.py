@@ -99,6 +99,11 @@ class KVStore:
                     self.lessor.attach(lease_id, key)
                 except LeaseNotFoundError:
                     pass  # revoked after the final put; nothing to attach
+        # A fully-compacted store can have ZERO revision rows; the
+        # revision counter must still resume at the compaction point
+        # (ref: kvstore.go restore: currentRev = max(currentRev,
+        # compactMainRev)).
+        self.current_rev = max(self.current_rev, self.compact_rev)
         sched = rt.get(bk.META, SCHEDULED_COMPACT_KEY)
         if sched is not None:
             srev = struct.unpack("<q", sched)[0]
