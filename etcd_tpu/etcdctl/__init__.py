@@ -442,8 +442,11 @@ def cmd_lease(args, pr: Printer) -> int:
                     f"remaining({d['ttl']}s)"
                 )
                 if args.keys:
-                    ks = [bytes.fromhex(k).decode("utf-8", "replace")
-                          if isinstance(k, str) else k for k in d.get("keys", [])]
+                    # The server reports attached keys as plain strings
+                    # (LeaseItem.key).
+                    ks = [k.decode("utf-8", "replace")
+                          if isinstance(k, bytes) else k
+                          for k in d.get("keys", [])]
                     msg += f", attached keys({ks})"
                 print(msg)
         elif args.lease_cmd == "list":

@@ -1,0 +1,185 @@
+"""More ctl e2e coverage over real member + CLI processes
+(ref: tests/e2e/ctl_v3_watch_test.go, ctl_v3_lease_test.go,
+ctl_v3_member_test.go, ctl_v3_move_leader_test.go,
+ctl_v3_elect_test.go, ctl_v3_lock_test.go, ctl_v3_compact tests,
+ctl_v3_auth_test.go shapes)."""
+
+import json
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ..framework.e2e import E2ECluster, etcdctl, free_ports
+
+pytestmark = pytest.mark.e2e
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    root = tmp_path_factory.mktemp("e2e-more")
+    c = E2ECluster(str(root), n=3)
+    c.start()
+    yield c
+    c.close()
+
+
+def _env():
+    from ..framework.e2e import _env as fenv
+
+    return fenv()
+
+
+def ctl_popen(endpoints, *args):
+    return subprocess.Popen(
+        [sys.executable, "-m", "etcd_tpu.etcdctl",
+         "--endpoints", endpoints, *args],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def test_watch_streams_put_event(cluster):
+    """ref: ctl_v3_watch_test.go — a watching CLI process receives the
+    PUT made by another CLI process."""
+    eps = cluster.endpoints()
+    w = ctl_popen(eps, "watch", "wkey", "--max-events", "1")
+    try:
+        time.sleep(1.0)  # let the watch establish
+        rc, _out, err = etcdctl(eps, "put", "wkey", "wval")
+        assert rc == 0, err
+        out, _ = w.communicate(timeout=30)
+        assert "PUT" in out and "wkey" in out and "wval" in out
+    finally:
+        if w.poll() is None:
+            w.kill()
+
+
+def test_lease_grant_ttl_revoke(cluster):
+    """ref: ctl_v3_lease_test.go — grant, attach via put --lease,
+    timetolive --keys, revoke deletes the key."""
+    eps = cluster.endpoints()
+    rc, out, err = etcdctl(eps, "lease", "grant", "300")
+    assert rc == 0, err
+    m = re.search(r"lease ([0-9a-f]+) granted with TTL\(300s\)", out)
+    assert m, out
+    lid = m.group(1)
+
+    rc, _out, err = etcdctl(eps, "put", "lk", "lv", "--lease", lid)
+    assert rc == 0, err
+    rc, out, _ = etcdctl(eps, "lease", "timetolive", lid, "--keys")
+    assert rc == 0 and "attached keys" in out and "lk" in out
+
+    rc, out, _ = etcdctl(eps, "lease", "revoke", lid)
+    assert rc == 0 and "revoked" in out
+    rc, out, _ = etcdctl(eps, "get", "lk")
+    assert rc == 0 and out.strip() == ""
+    rc, out, _ = etcdctl(eps, "lease", "timetolive", lid)
+    assert rc == 0 and "already expired" in out
+
+
+def test_member_list(cluster):
+    """ref: ctl_v3_member_test.go memberListTest."""
+    rc, out, err = etcdctl(cluster.endpoints(), "-w", "json",
+                           "member", "list")
+    assert rc == 0, err
+    data = json.loads(out)
+    members = data.get("members", data)
+    assert len(members) == 3
+
+
+def _leader_and_follower(cluster):
+    leader = follower = None
+    for p in cluster.procs:
+        rc, out, _ = etcdctl(f"127.0.0.1:{p.client_port}", "-w", "json",
+                             "endpoint", "status")
+        if rc != 0:
+            continue
+        st = json.loads(out)[0]["Status"]
+        if st["is_leader"]:
+            leader = (p, st["member_id"])
+        else:
+            follower = (p, st["member_id"])
+    return leader, follower
+
+
+def test_move_leader(cluster):
+    """ref: ctl_v3_move_leader_test.go — leadership transfers to the
+    requested member."""
+    leader, follower = _leader_and_follower(cluster)
+    assert leader and follower
+    rc, out, err = etcdctl(
+        f"127.0.0.1:{leader[0].client_port}",
+        "move-leader", f"{follower[1]:x}",
+    )
+    assert rc == 0, err + out
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        new_leader, _ = _leader_and_follower(cluster)
+        if new_leader and new_leader[1] == follower[1]:
+            return
+        time.sleep(0.5)
+    pytest.fail("leadership did not move")
+
+
+def test_elect_campaign_and_observe(cluster):
+    """ref: ctl_v3_elect_test.go — a campaigner wins and an observer
+    sees its proposal."""
+    eps = cluster.endpoints()
+    camp = ctl_popen(eps, "elect", "e2e-elect", "proposal-1",
+                     "--hold-seconds", "30")
+    try:
+        deadline = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            rc, out, _ = etcdctl(eps, "elect", "--listen", "e2e-elect",
+                                 timeout=10)
+            ok = rc == 0 and "proposal-1" in out
+            if not ok:
+                time.sleep(0.5)
+        assert ok, "observer never saw the campaigned proposal"
+    finally:
+        camp.kill()
+
+
+def test_lock_mutual_exclusion(cluster):
+    """ref: ctl_v3_lock_test.go — a held lock blocks a second locker
+    until released."""
+    eps = cluster.endpoints()
+    holder = ctl_popen(eps, "lock", "e2e-lock", "--hold-seconds", "20")
+    try:
+        # Wait until the holder prints its key (lock acquired).
+        deadline = time.monotonic() + 30
+        line = holder.stdout.readline()
+        assert line.startswith("e2e-lock"), line
+        # A second locker with a short timeout cannot acquire it.
+        rc, out, err = etcdctl(eps, "--command-timeout", "3",
+                               "lock", "e2e-lock", timeout=30)
+        assert rc != 0, f"second locker acquired a held lock: {out}"
+    finally:
+        holder.kill()
+    # After the holder dies (session lease revoked), locking succeeds.
+    rc, out, err = etcdctl(eps, "--command-timeout", "30",
+                           "lock", "e2e-lock", timeout=60)
+    assert rc == 0, err
+
+
+def test_compact_and_defrag(cluster):
+    """ref: ctl_v3 compaction/defrag shapes — old revisions become
+    unreadable with the canonical compacted error; defrag succeeds."""
+    eps = cluster.endpoints()
+    revs = []
+    for i in range(3):
+        rc, _o, _e = etcdctl(eps, "put", "ck", f"v{i}")
+        assert rc == 0
+    rc, out, _ = etcdctl(eps, "-w", "json", "get", "ck")
+    assert rc == 0
+    rev = json.loads(out)["header"]["revision"]
+    rc, out, _ = etcdctl(eps, "compaction", str(rev))
+    assert rc == 0 and f"compacted revision {rev}" in out
+    rc, out, err = etcdctl(eps, "get", "ck", "--rev", str(rev - 2))
+    assert rc != 0 and "compacted" in (out + err).lower()
+    rc, out, _ = etcdctl(eps, "defrag")
+    assert rc == 0 and out.count("Finished defragmenting") == 3
