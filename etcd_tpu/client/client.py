@@ -40,6 +40,12 @@ IDEMPOTENT = {
     "RoleList",
     "WatchCreate",
     "LeaseKeepAlive",
+    # Lock/Campaign claims are keyed by (name, lease): retrying re-enters
+    # the same server-side wait on the same ownership key, so a retry
+    # after a dropped connection continues the claim instead of
+    # duplicating it (ref: v3lock.go Lock — key is <name>/<lease-hex>).
+    "Lock",
+    "Campaign",
 }
 
 
@@ -73,6 +79,7 @@ class _Pending:
     result: Any = None
     error: Optional[Dict] = None
     sent: bool = False
+    method: str = ""  # diagnostics: names the call in conn-loss errors
 
 
 class WatchHandle:
@@ -313,8 +320,13 @@ class Client:
             pend = list(self._pending.values())
             self._pending.clear()
             self._observe_early.clear()  # ids are per-connection
+        self._fail_pendings(pend)
+
+    @staticmethod
+    def _fail_pendings(pend: List["_Pending"]) -> None:
         for p in pend:
-            p.error = {"type": "ConnectionError", "msg": "connection lost"}
+            p.error = {"type": "ConnectionError",
+                       "msg": f"connection lost ({p.method or 'call'} in flight)"}
             p.ev.set()
 
     # -- unary calls -----------------------------------------------------------
@@ -377,6 +389,15 @@ class Client:
         with self._lock:
             sock = self._sock
             self._sock = None
+            # Requests in flight on the dying connection would otherwise
+            # hang until their own deadline: the old read loop skips its
+            # pending-failure pass once _reconnect_gen moves on (it can't
+            # tell which pendings were re-issued on the new conn). Fail
+            # them here so waiters see the break immediately and the
+            # retry loop re-sends the retry-safe ones.
+            pend = list(self._pending.values())
+            self._pending.clear()
+        self._fail_pendings(pend)
         if sock is not None:
             try:
                 sock.close()
@@ -390,7 +411,7 @@ class Client:
             sock = self._sock
             rid = self._next_id
             self._next_id += 1
-            p = _Pending()
+            p = _Pending(method=method)
             self._pending[rid] = p
         if sock is None:
             with self._lock:

@@ -8,6 +8,7 @@ replay → raft), then client RPC + metrics/health HTTP serving.
 
 from __future__ import annotations
 
+import socket
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -162,6 +163,7 @@ def start_etcd(cfg: Config) -> Etcd:
         peer_hash_fetcher=transport_peer_fetcher(transport),
         initial_corrupt_check=cfg.initial_corrupt_check,
         corrupt_check_time=cfg.corrupt_check_time,
+        client_tls_info=cfg.client_tls_info(),
     )
     try:
         server = EtcdServer(scfg)
@@ -172,6 +174,23 @@ def start_etcd(cfg: Config) -> Etcd:
         client_bind = parse_urls(cfg.listen_client_urls)[0]
         e.rpc = V3RPCServer(server, bind=client_bind,
                             tls_info=cfg.client_tls_info())
+        # Publish this member's serving address cluster-wide (ref:
+        # server.go publishV3). Advertise flags win; otherwise the
+        # actually-bound listener address (covers port-0 test configs),
+        # with a wildcard bind host swapped for a routable one — a
+        # published 0.0.0.0 would make peers' forwards dial themselves.
+        scheme = "https" if cfg.client_tls_info() else "http"
+        if cfg.advertise_client_urls:
+            adv = cfg.advertise_client_urls
+        else:
+            host, port = e.rpc.addr[0], e.rpc.addr[1]
+            if host in ("0.0.0.0", "::"):
+                try:
+                    host = socket.gethostbyname(socket.gethostname())
+                except OSError:
+                    host = "127.0.0.1"
+            adv = f"{scheme}://{host}:{port}"
+        server.publish(cfg.name, [u.strip() for u in adv.split(",")])
 
         if cfg.enable_v2:
             # Legacy /v2/keys listener (ref: --enable-v2; the reference

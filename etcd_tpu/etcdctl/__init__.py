@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -668,29 +669,110 @@ def cmd_role(args, pr: Printer) -> int:
         c.close()
 
 
+def _lock_pull_flags(args, raw_argv: Optional[List[str]] = None) -> None:
+    """argparse.REMAINDER swallows everything after the lockname, so
+    `lock name --ttl 5 cmd ...` lands the flags in exec_command.  Pull
+    the lock command's own flags back out of the head of the remainder
+    (the reference registers them on the command so position doesn't
+    matter: etcdctl/ctlv3/command/lock_command.go).  Extraction stops at
+    the first non-flag token — that token starts the exec command, whose
+    own flags are passed through verbatim — or at a literal `--`.
+    argparse strips a leading `--` out of the REMAINDER itself, so the
+    raw argv is consulted to honor a `--` placed right before it."""
+    spec = {"--ttl": ("ttl", int), "--hold-seconds": ("hold_seconds", float)}
+    rest = list(args.exec_command or [])
+    if (raw_argv and rest
+            and raw_argv[-len(rest) - 1:] == ["--", *rest]):
+        return  # user wrote `lock name -- cmd...`: all verbatim
+    out: list = []
+    i = 0
+    while i < len(rest):
+        tok = rest[i]
+        if tok == "--":
+            out.extend(rest[i + 1:])
+            break
+        hit = None
+        for flag, (attr, conv) in spec.items():
+            val = None
+            if tok == flag:
+                if i + 1 >= len(rest):
+                    raise SystemExit(f"flag needs an argument: {flag}")
+                val, step = rest[i + 1], 2
+            elif tok.startswith(flag + "="):
+                val, step = tok.split("=", 1)[1], 1
+            if val is not None:
+                try:
+                    hit = (attr, conv(val), step)
+                except ValueError:
+                    raise SystemExit(
+                        f"invalid argument {val!r} for {flag} flag")
+                break
+        if hit is None:
+            out.extend(rest[i:])
+            break
+        setattr(args, hit[0], hit[1])
+        i += hit[2]
+    args.exec_command = out
+
+
 def cmd_lock(args, pr: Printer) -> int:
     """Drives the server-side Lock/Unlock RPCs (v3lock.go) — the lock
     logic runs in the server, the CLI only owns the session lease."""
     from ..client.concurrency import Session
 
+    _lock_pull_flags(args, getattr(args, "_raw_argv", None))
     c = _client(args)
+    s = None
     try:
         s = Session(c, ttl=args.ttl)
         key = c.lock(args.lockname.encode(), s.lease_id,
                      timeout=args.command_timeout)
-        try:
-            print(key.decode("utf-8", "replace"))
-            if args.exec_command:
-                import subprocess
+        print(key.decode("utf-8", "replace"), flush=True)
+        if args.exec_command:
+            import subprocess
 
-                return subprocess.call(args.exec_command)
-            # Hold until interrupted (the reference blocks).
-            time.sleep(args.hold_seconds)
-        finally:
+            env = dict(os.environ)
+            env["ETCD_LOCK_KEY"] = key.decode("utf-8", "replace")
+            kvs = c.get(key).kvs
+            env["ETCD_LOCK_REV"] = str(kvs[0].mod_revision if kvs else 0)
+            try:
+                rc = subprocess.call(args.exec_command, env=env)
+            except KeyboardInterrupt:
+                # Ordinary shutdown: release like the reference's
+                # SIGINT path (lock_command.go:80-88,117).
+                c.unlock(key)
+                s.close()
+                return 0
+            except OSError as e:
+                # Spawn failure is the crash analog: do NOT
+                # unlock/revoke — the lock survives until the session
+                # lease TTL expires (the reference releases a crashed
+                # holder via lease expiry; deliberate divergence from
+                # lock_command.go:99, which unlocks even on spawn
+                # error, so a typo'd command cannot silently release a
+                # lock another process may still believe it fenced).
+                print(f"etcdctl lock: exec failed: {e}", file=sys.stderr)
+                return 1
+            # The command ran: unlock and propagate its exit code
+            # (lock_command.go:94-104 unlocks before returning the
+            # command's error; getExitCodeFromError keeps the code).
             c.unlock(key)
             s.close()
+            return rc
+        # Hold until interrupted (the reference blocks).
+        try:
+            time.sleep(args.hold_seconds)
+        except KeyboardInterrupt:
+            pass  # fall through to the ordinary-shutdown unlock
+        c.unlock(key)
+        s.close()
         return 0
     except KeyboardInterrupt:
+        # Ctrl-C while still waiting to acquire: withdraw the claim by
+        # revoking the session lease (its queued ownership key dies with
+        # it), mirroring the reference's SIGINT context-cancel path.
+        if s is not None:
+            s.close()
         return 0
     finally:
         c.close()
@@ -1073,6 +1155,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
     args = parser.parse_args(argv)
+    args._raw_argv = argv  # lock needs it: REMAINDER eats a leading `--`
     if args.cmd is None:
         parser.print_help()
         return 2

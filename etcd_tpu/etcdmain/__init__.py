@@ -83,12 +83,39 @@ def _config_from_args(args: argparse.Namespace) -> Config:
 
 
 def _run_etcd(args: argparse.Namespace) -> int:
+    # Debug hook standing in for Go's SIGQUIT goroutine dump: SIGUSR1
+    # writes every thread's stack to stderr (the e2e harness and a
+    # human operator use it to diagnose a wedged member in place).
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, file=sys.stderr)
     try:
         cfg = _config_from_args(args)
         e = start_etcd(cfg)
     except ConfigError as err:
         print(f"error: {err}", file=sys.stderr)
         return 1
+
+    def _dump_lessor(signum, frame):
+        try:
+            les = e.server.lessor
+            with les._lock:
+                lines = [
+                    f"  lease {l.id:x} ttl={l.ttl} rem_ttl={l.remaining_ttl} "
+                    f"remaining={l.remaining():.1f} "
+                    f"queued={l.id in les.expired_queue} "
+                    f"pending={l.id in les._expired_pending}"
+                    for l in les.lease_map.values()
+                ]
+                print(
+                    f"LESSOR primary={les._primary} "
+                    f"n={len(les.lease_map)}\n" + "\n".join(lines),
+                    file=sys.stderr, flush=True,
+                )
+        except Exception as exc:  # noqa: BLE001 — diagnostics only
+            print(f"LESSOR dump failed: {exc!r}", file=sys.stderr, flush=True)
+
+    signal.signal(signal.SIGUSR2, _dump_lessor)
     ch, cp = e.client_addr
     mh, mp = e.metrics_addr
     print(

@@ -13,5 +13,6 @@ from .lessor import (  # noqa: F401
     Lessor,
     LeaseItem,
     NoLease,
+    NotPrimaryError,
     FOREVER,
 )

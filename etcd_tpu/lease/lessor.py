@@ -57,6 +57,11 @@ class LeaseExistsError(Exception):
     """ref: ErrLeaseExists."""
 
 
+class NotPrimaryError(Exception):
+    """ref: lease.ErrNotPrimary — renew/checkpoint demand the primary
+    (expiry-tracking) lessor; distinct from a missing lease."""
+
+
 class LeaseExpiredError(Exception):
     """ref: ErrLeaseTTLTooLarge/expired paths."""
 
@@ -246,7 +251,7 @@ class Lessor:
         """Returns the new TTL. Primary only (ref: lessor.go:425-463)."""
         with self._lock:
             if not self._primary:
-                raise LeaseNotFoundError("not primary lessor")
+                raise NotPrimaryError("not primary lessor")
             lease = self.lease_map.get(lease_id)
             if lease is None:
                 raise LeaseNotFoundError(str(lease_id))

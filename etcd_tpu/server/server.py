@@ -36,7 +36,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..auth.store import AuthInfo, AuthStore
 from ..auth.simple_token import SimpleTokenProvider
-from ..lease.lessor import Lessor, LeaseItem, NoLease
+from ..lease.lessor import (
+    Lessor, LeaseItem, LeaseNotFoundError, NoLease, NotPrimaryError,
+)
 from ..pkg import failpoint
 from ..pkg.idutil import Generator
 from ..pkg.schedule import FIFOScheduler
@@ -152,6 +154,9 @@ class ServerConfig:
     peer_hash_fetcher: Any = None
     initial_corrupt_check: bool = False
     corrupt_check_time: float = 0.0  # seconds; 0 → no periodic monitor
+    # TLSInfo for member→member calls against peers' CLIENT listeners
+    # (renew forwarding); None in plaintext clusters.
+    client_tls_info: Any = None
     # Raft implementation behind the Node contract: "host" = the
     # reference-shaped Python core, "tpu" = the batched device engine
     # (requires dense member ids 1..R; ref: SURVEY §7.6
@@ -189,6 +194,8 @@ class EtcdServer:
         self._term = 0
         self._lead = NONE
         self._lead_lock = threading.Lock()
+        self._fwd_lock = threading.Lock()
+        self._fwd_clients: Dict[str, object] = {}  # leader ep -> Client
 
         self.w = Wait()
         self.apply_wait = WaitTime()
@@ -907,14 +914,107 @@ class EtcdServer:
         smet.lease_revoked.inc()
         return resp
 
-    def lease_renew(self, lease_id: int) -> int:
-        """Keepalive: primary lessor only; followers raise NotLeader and
-        the client retries against the leader (v3_server.go LeaseRenew)."""
+    def publish(self, name: str, client_urls: List[str]) -> None:
+        """Replicate this member's attributes (name + serving client
+        URLs) so peers can resolve each other's client endpoints — the
+        renew-forwarding path depends on it (ref: server.go:2097
+        publishV3, retried until the proposal applies)."""
+        def loop() -> None:
+            req = {"id": self.id, "name": name,
+                   "client_urls": list(client_urls)}
+            while not self._stopped.is_set():
+                try:
+                    self.process_internal_raft_request(
+                        "cluster_member_attr", req)
+                    return
+                except Exception:  # noqa: BLE001 — no leader yet etc.
+                    if self._stopped.wait(1.0):
+                        return
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"publish-{self.id:x}")
+        t.start()
+        self._threads.append(t)
+
+    def lease_renew(self, lease_id: int, local_only: bool = False) -> int:
+        """Keepalive: the expiry clock lives on the primary lessor, so a
+        follower forwards the renew to the leader instead of bouncing
+        the client (ref: v3_server.go:244-270 LeaseRenew → leasehttp
+        RenewHTTP against the leader). ``local_only`` marks an
+        already-forwarded request — one hop max, a stale-leader target
+        answers NotLeader rather than forwarding again."""
         if not self.lessor.is_primary():
-            raise NotLeaderError()
-        ttl = self.lessor.renew(lease_id)
+            if local_only:
+                raise NotLeaderError()
+            return self._forward_lease_renew(lease_id)
+        try:
+            ttl = self.lessor.renew(lease_id)
+        except NotPrimaryError as exc:
+            # Demoted between the is_primary check and the renew: the
+            # caller should chase the new leader, not see a lease error.
+            raise NotLeaderError() from exc
         smet.lease_renewed.inc()
         return ttl
+
+    def _forward_lease_renew(self, lease_id: int) -> int:
+        """One-hop renew forward to the current leader's client URL."""
+        lead = self.leader()
+        m = self.cluster.member(lead) if lead != NONE else None
+        if m is None or not m.client_urls:
+            raise NotLeaderError()
+        ep = m.client_urls[0]
+        from ..client.client import ClientError
+        try:
+            c = self._leader_fwd_client(ep)
+            resp = c._request(
+                "LeaseKeepAlive",
+                {"id": lease_id, "local_only": True}, timeout=2.0)
+            return resp["ttl"]
+        except Exception as exc:  # noqa: BLE001 — surface as NotLeader
+            app_level = isinstance(exc, ClientError) and exc.etype not in (
+                "ConnectionError", "Timeout", "Closed")
+            if not app_level:
+                # Transport-level failure: the cached channel is suspect.
+                # Application errors rode a healthy connection; keep it.
+                with self._fwd_lock:
+                    cli = self._fwd_clients.pop(ep, None)
+                if cli is not None:
+                    try:
+                        cli.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+            if isinstance(exc, ClientError) and exc.etype in (
+                    "LeaseNotFoundError", "LeaseExpiredError"):
+                raise LeaseNotFoundError(str(lease_id)) from exc
+            raise NotLeaderError() from exc
+
+    def _leader_fwd_client(self, ep: str):
+        """Cached member→leader client channel for renew forwarding.
+        Dials outside _fwd_lock so a slow/unreachable leader cannot
+        serialize every concurrent renew behind one connect."""
+        from ..client.client import Client
+        from ..embed.config import parse_urls
+        with self._fwd_lock:
+            c = self._fwd_clients.get(ep)
+        if c is not None:
+            return c
+        host, port = parse_urls(ep)[0]
+        tls = self.cfg.client_tls_info if ep.startswith("https") else None
+        c = Client([(host, port)], dial_timeout=1.0,
+                   request_timeout=2.0, tls_info=tls)
+        with self._fwd_lock:
+            prev = self._fwd_clients.get(ep)
+            if prev is not None:  # raced: keep the first, drop ours
+                winner, loser = prev, c
+            else:
+                self._fwd_clients[ep] = c
+                winner, loser = c, None
+        if loser is not None:
+            try:
+                loser.close()
+            except Exception:  # noqa: BLE001
+                pass
+        return winner
 
     def lease_time_to_live(self, lease_id: int, keys: bool = False):
         lease = self.lessor.lookup(lease_id)
@@ -1217,6 +1317,13 @@ class EtcdServer:
         if self.compactor is not None:
             self.compactor.stop()
         self.node.stop()
+        with self._fwd_lock:
+            fwd, self._fwd_clients = list(self._fwd_clients.values()), {}
+        for c in fwd:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         for t in self._threads:
             if t.is_alive():
                 t.join(timeout=5)

@@ -79,6 +79,13 @@ class FramedServerConn:
                 threading.Thread(
                     target=self._handle, args=(req,), daemon=True
                 ).start()
+        except OSError:
+            return  # peer went away; on_close in finally
+        except Exception:  # noqa: BLE001 — a framing crash must be loud
+            import sys
+            import traceback
+            print("v3rpc conn read loop crashed:", file=sys.stderr)
+            traceback.print_exc()
         finally:
             self.on_close()
             try:

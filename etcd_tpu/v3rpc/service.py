@@ -162,7 +162,8 @@ class _Conn(FramedServerConn):
         if method == "LeaseRevoke":
             return s.lease_revoke(params["id"], token=token)
         if method == "LeaseKeepAlive":
-            ttl = s.lease_renew(params["id"])
+            ttl = s.lease_renew(
+                params["id"], local_only=params.get("local_only", False))
             return {"id": params["id"], "ttl": ttl}
         if method == "LeaseTimeToLive":
             out = s.lease_time_to_live(params["id"], keys=params.get("keys", False))

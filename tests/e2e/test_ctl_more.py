@@ -166,6 +166,47 @@ def test_lock_mutual_exclusion(cluster):
     assert rc == 0, err
 
 
+def test_lock_exec_crash_keeps_lease(cluster):
+    """ref: lock_command.go — a holder whose exec'd command cannot even
+    be spawned is a crash, not a release: the key stays locked until the
+    session lease TTL expires (etcd releases crashed holders via lease
+    expiry, not cleanup)."""
+    eps = cluster.endpoints()
+    t0 = time.monotonic()
+    rc, _out, err = etcdctl(eps, "lock", "e2e-crashlock", "--ttl", "5",
+                            "/nonexistent-binary-xyzzy", timeout=30)
+    assert rc != 0, "spawn failure must exit nonzero"
+    # Immediately after, the lock must still be held (lease alive).
+    rc, out, _ = etcdctl(eps, "--command-timeout", "2",
+                         "lock", "e2e-crashlock", timeout=20)
+    waited = time.monotonic() - t0
+    if waited < 4.5:
+        assert rc != 0, (
+            f"lock acquired {waited:.1f}s after crash — lease was revoked "
+            f"instead of surviving to TTL: {out}")
+    # Once the 5s TTL lapses the lock becomes acquirable.
+    rc, out, err = etcdctl(eps, "--command-timeout", "30",
+                           "lock", "e2e-crashlock", timeout=60)
+    assert rc == 0, err
+
+
+def test_lock_exec_runs_and_propagates_exit_code(cluster):
+    """ref: lock_command.go:94-104 — a command that runs gets
+    ETCD_LOCK_KEY in its env; its exit code is propagated and the lock
+    is released immediately (unlock-before-return)."""
+    eps = cluster.endpoints()
+    rc, out, err = etcdctl(
+        eps, "lock", "e2e-execlock", "--ttl", "30", "--", sys.executable,
+        "-c", "import os,sys; sys.exit(7 if os.environ.get"
+        "('ETCD_LOCK_KEY','').startswith('e2e-execlock') else 3)",
+        timeout=60)
+    assert rc == 7, (rc, out, err)
+    # Unlocked immediately (no TTL wait): a fresh locker succeeds fast.
+    rc, out, err = etcdctl(eps, "--command-timeout", "5",
+                           "lock", "e2e-execlock", timeout=30)
+    assert rc == 0, err
+
+
 def test_compact_and_defrag(cluster):
     """ref: ctl_v3 compaction/defrag shapes — old revisions become
     unreadable with the canonical compacted error; defrag succeeds."""

@@ -14,6 +14,7 @@ from etcd_tpu.lease import (
     LeaseNotFoundError,
     Lessor,
     NoLease,
+    NotPrimaryError,
 )
 from etcd_tpu.storage import backend as bk
 
@@ -113,9 +114,11 @@ class TestExpiry:
         le.stop()
 
     def test_renew_requires_primary(self, be):
+        """ref: lessor.go TestLessorRenew — renew off-primary is
+        ErrNotPrimary, NOT lease-not-found (the lease is fine)."""
         le = new_lessor(be)
         le.grant(1, 10)
-        with pytest.raises(LeaseNotFoundError):
+        with pytest.raises(NotPrimaryError):
             le.renew(1)
         le.stop()
 
