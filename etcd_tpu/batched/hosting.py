@@ -152,6 +152,9 @@ class MultiRaftMember:
         self.kvs = [GroupKV() for _ in range(num_groups)]
         self.applied_index = np.zeros(num_groups, np.int64)
         self._send = send_fn  # set by the router/transport
+        # Block fast path (SoA frames, see msgblock.py); routers that
+        # support it set this, others get the object fallback.
+        self._send_block: Optional[Callable[[int, "object"], None]] = None
         self._lock = threading.Lock()
         self.tick_interval = tick_interval
         # ReadIndex bookkeeping for linearizable readers: the latest
@@ -297,6 +300,14 @@ class MultiRaftMember:
         #     and two members sending to each other must not deadlock.
         if out and self._send is not None:
             self._send(self.id, out)
+        blk = rd.msg_block
+        if blk is not None and len(blk):
+            if self._send_block is not None:
+                self._send_block(self.id, blk)
+            elif self._send is not None:
+                from .msgblock import block_messages
+
+                self._send(self.id, block_messages(blk))
         # 4. advance
         self.rn.advance()
         return rd
@@ -326,6 +337,13 @@ class MultiRaftMember:
                     )
                     self.wal.flush(sync=True)
         self.rn.step(group, m)
+
+    def deliver_block(self, blk) -> None:
+        """Batch entry point: payload-free messages as one SoA block
+        (no snapshots ever ride a block)."""
+        if self._stopped.is_set():
+            return
+        self.rn.step_block(blk)
 
     # -- API -------------------------------------------------------------------
 
@@ -434,6 +452,7 @@ class InProcRouter:
     def attach(self, m: MultiRaftMember) -> None:
         self.members[m.id] = m
         m._send = self.send
+        m._send_block = self.send_block
 
     def send(self, from_id: int, batch: List[Tuple[int, Message]]) -> None:
         with self._lock:
@@ -448,6 +467,22 @@ class InProcRouter:
             if mem is not None:
                 try:
                     mem.deliver(group, msg)
+                except Exception:  # noqa: BLE001 — drop, like a lossy net
+                    pass
+
+    def send_block(self, from_id: int, blk) -> None:
+        with self._lock:
+            if from_id in self._isolated:
+                return
+            targets = {
+                to: mem for to, mem in self.members.items()
+                if to not in self._isolated
+            }
+        for to, sub in blk.split_by_target().items():
+            mem = targets.get(to)
+            if mem is not None:
+                try:
+                    mem.deliver_block(sub)
                 except Exception:  # noqa: BLE001 — drop, like a lossy net
                     pass
 
@@ -470,6 +505,7 @@ class TCPRouter:
     etcdserver/raft.go:108-111)."""
 
     MAX_PENDING = 4096
+    BLOCK_SENTINEL = 0xFFFFFFFF  # group-id marker for SoA block frames
 
     def __init__(self, member: MultiRaftMember,
                  bind: Tuple[str, int] = ("127.0.0.1", 0)) -> None:
@@ -483,6 +519,7 @@ class TCPRouter:
         self._max_frame = MAX_FRAME
         self.member = member
         member._send = self.send
+        member._send_block = self.send_block
         self._stopped = threading.Event()
         self._lock = threading.Lock()
         # peer id -> (queue, sender thread); established lazily.
@@ -515,19 +552,9 @@ class TCPRouter:
             if self._stopped.is_set():
                 return
             for to in targets:
-                ent = self._peers.get(to)
-                if ent is None:
-                    addr = self._addrs.get(to)
-                    if addr is None:
-                        continue
-                    q: "_q.Queue" = _q.Queue(maxsize=self.MAX_PENDING)
-                    t = threading.Thread(
-                        target=self._sender, args=(to, addr, q),
-                        daemon=True)
-                    self._peers[to] = (q, t)
-                    t.start()
-                    ent = self._peers[to]
-                queues[to] = ent[0]
+                ent = self._ensure_peer_locked(to)
+                if ent is not None:
+                    queues[to] = ent[0]
         for group, m in batch:
             q2 = queues.get(m.to)
             if q2 is None:
@@ -537,24 +564,73 @@ class TCPRouter:
             except _q.Full:  # drop, never block the round loop
                 pass
 
+    def send_block(self, _from_id: int, blk) -> None:
+        """Ship a SoA block: ONE pre-encoded frame per target member
+        (vs one frame per message on the object path)."""
+        import queue as _q
+
+        subs = blk.split_by_target()
+        queues: Dict[int, "_q.Queue"] = {}
+        with self._lock:
+            if self._stopped.is_set():
+                return
+            for to in subs:
+                ent = self._ensure_peer_locked(to)
+                if ent is not None:
+                    queues[to] = ent[0]
+        for to, sub in subs.items():
+            q2 = queues.get(to)
+            if q2 is None:
+                continue
+            body = sub.to_bytes()
+            frame = struct.pack(
+                "<II", len(body) + 4, self.BLOCK_SENTINEL) + body
+            try:
+                q2.put_nowait(frame)
+            except _q.Full:  # drop, never block the round loop
+                pass
+
+    def _ensure_peer_locked(self, to: int):
+        """Resolve or lazily create the (queue, sender) for a peer.
+        Caller holds _lock."""
+        import queue as _q
+
+        ent = self._peers.get(to)
+        if ent is None:
+            addr = self._addrs.get(to)
+            if addr is None:
+                return None
+            q: "_q.Queue" = _q.Queue(maxsize=self.MAX_PENDING)
+            t = threading.Thread(
+                target=self._sender, args=(to, addr, q), daemon=True)
+            self._peers[to] = (q, t)
+            t.start()
+            ent = self._peers[to]
+        return ent
+
     def _sender(self, peer_id: int, addr: Tuple[str, int], q) -> None:
         sock = None
         while not self._stopped.is_set():
             item = q.get()
             if item is None:
                 break
-            group, m = item
-            # encode_message returns a length-prefixed frame; strip its
-            # prefix — this framing carries its own total + group id.
-            payload = self._enc(m)[4:]
-            if len(payload) + 4 > self._max_frame:
-                # The receiver would kill the stream on an oversized
-                # frame and the resend would churn it forever; drop it
-                # here instead (the raft layer retries via snapshots).
-                continue
-            frame = (
-                struct.pack("<II", len(payload) + 4, group) + payload
-            )
+            if isinstance(item, bytes):  # pre-encoded block frame
+                frame = item
+            else:
+                group, m = item
+                # encode_message returns a length-prefixed frame; strip
+                # its prefix — this framing carries its own total +
+                # group id.
+                payload = self._enc(m)[4:]
+                if len(payload) + 4 > self._max_frame:
+                    # The receiver would kill the stream on an
+                    # oversized frame and the resend would churn it
+                    # forever; drop it here instead (the raft layer
+                    # retries via snapshots).
+                    continue
+                frame = (
+                    struct.pack("<II", len(payload) + 4, group) + payload
+                )
             for _attempt in (0, 1):
                 if sock is None:
                     try:
@@ -625,6 +701,18 @@ class TCPRouter:
             if body is None:
                 break
             (group,) = struct.unpack_from("<I", body)
+            if group == self.BLOCK_SENTINEL:
+                from .msgblock import MsgBlock
+
+                try:
+                    blk = MsgBlock.from_bytes(body[4:])
+                except ValueError:  # corrupt frame: drop conn
+                    break
+                try:
+                    self.member.deliver_block(blk)
+                except Exception:  # noqa: BLE001 — lossy-net semantics
+                    pass
+                continue
             try:
                 m = self._dec(body[4:])
             except Exception:  # noqa: BLE001 — corrupt frame: drop conn

@@ -1,0 +1,213 @@
+"""SoA message blocks: the hosted fast path for payload-free raft
+traffic.
+
+At G=1024 a single heartbeat round emits ~2*G messages per member;
+materializing each as a Python ``Message`` (collect -> encode -> socket
+-> decode -> stage) costs ~100us apiece, which is the entire round
+budget — the hosted service rate was gated on it. Payload-free message
+types (heartbeats, acks, votes, empty appends, TimeoutNow) instead stay
+as one packed numpy record array end-to-end: sliced straight out of the
+device outbox, shipped as ONE frame per peer per round, and scattered
+into the next round's inbox with vectorized first-wins merging.
+
+Only MsgApp-with-entries and MsgSnap — the two types that carry bytes
+the device never sees — take the per-message object path. This is the
+batched analog of the reference's two rafthttp channels: the cheap
+high-rate stream for small messages and the pipeline for big ones
+(ref: server/etcdserver/api/rafthttp/peer.go:337-349).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from .step import (
+    KIND_APP,
+    KIND_APP_RESP,
+    KIND_HB,
+    KIND_HB_RESP,
+    KIND_VOTE,
+    KIND_VOTE_RESP,
+    T_APP,
+    T_APP_RESP,
+    T_HB,
+    T_HB_RESP,
+    T_PREVOTE,
+    T_PREVOTE_RESP,
+    T_SNAP,
+    T_TIMEOUT_NOW,
+    T_VOTE,
+    T_VOTE_RESP,
+)
+
+# One wire record per message; packed little-endian, 33 bytes.
+REC_DTYPE = np.dtype([
+    ("row", "<u4"),          # receiver-side row (group id in hosting)
+    ("to", "<u1"),           # target slot + 1 (member id)
+    ("frm", "<u1"),          # sender slot + 1
+    ("lane", "<u1"),         # inbox lane (KIND_*)
+    ("type", "<u1"),         # wire type (T_*)
+    ("reject", "<u1"),
+    ("term", "<u4"),
+    ("log_term", "<u4"),
+    ("index", "<u4"),
+    ("commit", "<u4"),
+    ("reject_hint", "<u4"),
+    ("ctx", "<u4"),          # 4-byte context word
+])
+
+# Wire type -> inbox lane, as a lookup table for vectorized use
+# (mirrors rawnode._LANE).
+_MAX_T = 32
+LANE_OF = np.full(_MAX_T, -1, np.int8)
+for _t, _lane in (
+    (T_VOTE, KIND_VOTE), (T_PREVOTE, KIND_VOTE),
+    (T_APP, KIND_APP), (T_SNAP, KIND_APP),
+    (T_HB, KIND_HB), (T_TIMEOUT_NOW, KIND_HB),
+    (T_VOTE_RESP, KIND_VOTE_RESP), (T_PREVOTE_RESP, KIND_VOTE_RESP),
+    (T_APP_RESP, KIND_APP_RESP),
+    (T_HB_RESP, KIND_HB_RESP),
+):
+    LANE_OF[_t] = _lane
+
+
+class MsgBlock:
+    """A batch of payload-free messages as one structured array."""
+
+    __slots__ = ("rec",)
+
+    def __init__(self, rec: np.ndarray) -> None:
+        self.rec = rec
+
+    def __len__(self) -> int:
+        return len(self.rec)
+
+    def to_bytes(self) -> bytes:
+        return self.rec.tobytes()
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "MsgBlock":
+        if len(b) % REC_DTYPE.itemsize:
+            raise ValueError(f"block frame not a multiple of "
+                             f"{REC_DTYPE.itemsize}: {len(b)}")
+        return cls(np.frombuffer(b, REC_DTYPE))
+
+    def split_by_target(self) -> Dict[int, "MsgBlock"]:
+        """Partition by target member id (slot+1)."""
+        rec = self.rec
+        out: Dict[int, MsgBlock] = {}
+        for to in np.unique(rec["to"]):
+            out[int(to)] = MsgBlock(rec[rec["to"] == to])
+        return out
+
+
+def block_messages(blk: "MsgBlock") -> "list":
+    """Compat: materialize a block as (row, Message) tuples — for
+    low-volume consumers (single-group nodes, trace harnesses) that
+    want the object shape."""
+    from ..raft.types import Message, MessageType
+
+    out = []
+    for rec in blk.rec:
+        m = Message(
+            type=MessageType(int(rec["type"])),
+            to=int(rec["to"]),
+            from_=int(rec["frm"]),
+            term=int(rec["term"]),
+            log_term=int(rec["log_term"]),
+            index=int(rec["index"]),
+            commit=int(rec["commit"]),
+            reject=bool(rec["reject"]),
+            reject_hint=int(rec["reject_hint"]),
+        )
+        cw = int(rec["ctx"])
+        if cw:
+            m.context = cw.to_bytes(4, "little")
+        out.append((int(rec["row"]), m))
+    return out
+
+
+def collect_block(out_valid: np.ndarray, out: "object",
+                  slots: np.ndarray) -> "tuple[MsgBlock, np.ndarray]":
+    """Slice the simple messages out of a device outbox.
+
+    `out` is the numpy-materialized outbox (fields [n, R, K]); returns
+    (block, complex_mask) where complex_mask marks the slots that still
+    need the per-message path (MsgApp with entries, MsgSnap).
+    """
+    typ = np.asarray(out.type)
+    n_ents = np.asarray(out.n_ents)
+    simple = out_valid & (
+        ((typ != T_APP) & (typ != T_SNAP))
+        | ((typ == T_APP) & (n_ents == 0))
+    )
+    rows, tgt, k = np.nonzero(simple)
+    rec = np.empty(len(rows), REC_DTYPE)
+    t = typ[rows, tgt, k]
+    rec["row"] = rows
+    rec["to"] = tgt + 1
+    rec["frm"] = slots[rows] + 1
+    rec["lane"] = LANE_OF[t]
+    rec["type"] = t
+    rec["reject"] = np.asarray(out.reject)[rows, tgt, k]
+    rec["term"] = np.asarray(out.term)[rows, tgt, k]
+    rec["log_term"] = np.asarray(out.log_term)[rows, tgt, k]
+    rec["index"] = np.asarray(out.index)[rows, tgt, k]
+    rec["commit"] = np.asarray(out.commit)[rows, tgt, k]
+    rec["reject_hint"] = np.asarray(out.reject_hint)[rows, tgt, k]
+    rec["ctx"] = np.asarray(out.ctx)[rows, tgt, k]
+    return MsgBlock(rec), (out_valid & ~simple)
+
+
+def merge_blocks(
+    blocks: List[np.ndarray],
+    num_replicas: int,
+    num_kinds: int,
+    dense: Dict[str, np.ndarray],
+) -> List[np.ndarray]:
+    """Scatter queued block records into the dense inbox arrays.
+
+    `dense` holds the flat-viewable per-field arrays ([n, R, K]); slots
+    already filled (by the legacy per-message path) are respected. Per
+    inbox key (row, sender, lane) at most one record lands per round;
+    FIFO order across blocks is preserved: once a key has a deferred
+    record, later records for that key stay queued behind it. Returns
+    the residual blocks (in order).
+    """
+    valid = dense["valid"]
+    n_keys = valid.size
+    flat_valid = valid.reshape(-1)
+    barred = np.zeros(n_keys, bool)
+    residual: List[np.ndarray] = []
+    flat = {f: a.reshape(-1) for f, a in dense.items()}
+    for rec in blocks:
+        if len(rec) == 0:
+            continue
+        key = (
+            (rec["row"].astype(np.int64) * num_replicas
+             + (rec["frm"].astype(np.int64) - 1)) * num_kinds
+            + rec["lane"]
+        )
+        # First occurrence of each key within this block...
+        _, first_idx = np.unique(key, return_index=True)
+        firstmask = np.zeros(len(key), bool)
+        firstmask[first_idx] = True
+        # ...that is neither already filled nor behind a deferred one.
+        take = firstmask & ~flat_valid[key] & ~barred[key]
+        idx = key[take]
+        flat_valid[idx] = True
+        flat["type"][idx] = rec["type"][take]
+        flat["term"][idx] = rec["term"][take]
+        flat["log_term"][idx] = rec["log_term"][take]
+        flat["index"][idx] = rec["index"][take]
+        flat["commit"][idx] = rec["commit"][take]
+        flat["reject"][idx] = rec["reject"][take].astype(bool)
+        flat["reject_hint"][idx] = rec["reject_hint"][take]
+        flat["ctx"][idx] = rec["ctx"][take]
+        rest = ~take
+        if rest.any():
+            barred[key[rest]] = True
+            residual.append(rec[rest])
+    return residual

@@ -418,7 +418,12 @@ class BatchedNode:
             self.rn.install_snapshot_state(0, idx)
 
         messages = []
-        for _row, m in rd.messages:
+        all_msgs = list(rd.messages)
+        if rd.msg_block is not None and len(rd.msg_block):
+            from .msgblock import block_messages
+
+            all_msgs.extend(block_messages(rd.msg_block))
+        for _row, m in all_msgs:
             if int(m.type) == T_SNAP:
                 app = self._app_snap
                 if app is None or app.metadata.index < m.snapshot.metadata.index:

@@ -29,7 +29,9 @@ committed entries, then calls ``advance()``.
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -46,6 +48,7 @@ from ..raft.types import (
     Snapshot,
     SnapshotMetadata,
 )
+from .msgblock import MsgBlock, collect_block, merge_blocks
 from .state import BatchedConfig, BatchedState, LEADER, I32, init_state
 from .step import (
     KIND_APP,
@@ -117,6 +120,9 @@ class BatchedReady:
     # (row, [(index, term, data or None for internal/empty)])
     messages: List[Tuple[int, Message]]
     must_sync: bool
+    # Payload-free outbound messages as one SoA block (see msgblock.py);
+    # `messages` then carries only MsgApp-with-entries / MsgSnap.
+    msg_block: Optional[MsgBlock] = None
     # Quorum-confirmed ReadIndex batches this round: (row, seq, index)
     # (ref: Ready.ReadStates, read_only.go advance).
     read_states: List[Tuple[int, int, int]] = field(default_factory=list)
@@ -129,6 +135,7 @@ class BatchedReady:
         return bool(
             self.hardstates or self.entries or self.snapshots
             or self.committed or self.messages or self.read_states
+            or (self.msg_block is not None and len(self.msg_block))
         )
 
 
@@ -197,6 +204,7 @@ class BatchedRawNode:
         # Staging (guarded by _lock).
         self._lock = threading.Lock()
         self._pending: Dict[Tuple[int, int, int], deque] = {}
+        self._blocks: deque = deque()  # staged MsgBlock record arrays
         self._props: List[deque] = [deque() for _ in range(self.n)]
         self._ticks = np.zeros(self.n, np.int64)
         self._campaign = np.zeros(self.n, bool)
@@ -212,6 +220,14 @@ class BatchedRawNode:
 
         # In-flight round (between advance_round and advance).
         self._round: Optional[Tuple] = None
+
+        # Opt-in phase profiling (ETCD_TPU_PROF=1): per-phase seconds,
+        # read by benches/BENCH_NOTES captures.
+        self.prof: Optional[Dict[str, float]] = (
+            {"inbox": 0.0, "step": 0.0, "post": 0.0, "collect": 0.0,
+             "rounds": 0}
+            if os.environ.get("ETCD_TPU_PROF") else None
+        )
 
     # -- boot ------------------------------------------------------------------
 
@@ -368,6 +384,14 @@ class BatchedRawNode:
         with self._lock:
             self._pending.setdefault((row, from_slot, lane), deque()).append(m)
 
+    def step_block(self, blk: MsgBlock) -> None:
+        """Stage a batch of payload-free inbound messages (the SoA wire
+        fast path — see msgblock.py). One lock acquisition per batch."""
+        if len(blk) == 0:
+            return
+        with self._lock:
+            self._blocks.append(blk.rec)
+
     def install_snapshot_state(self, row: int, index: int,
                                applied_data_restored: bool = True) -> None:
         """Hosting layer notifies that app state for `row` was restored
@@ -383,15 +407,18 @@ class BatchedRawNode:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(
-                self._pending
+            if (
+                self._pending or self._blocks
                 or self._ticks.any()
                 or self._campaign.any()
                 or self._transfer.any()
                 or self._read_req.any()
-                or any(self._props[i] and self.m_role[i] == LEADER
-                       for i in range(self.n))
+            ):
+                return True
+            props = np.fromiter(
+                (bool(q) for q in self._props), bool, count=self.n
             )
+            return bool((props & (self.m_role == LEADER)).any())
 
     # -- the round -------------------------------------------------------------
 
@@ -399,6 +426,8 @@ class BatchedRawNode:
         assert self._round is None, "previous round not advanced"
         cfg = self.cfg
         r, e, w = cfg.num_replicas, cfg.max_ents_per_msg, cfg.window
+        prof = self.prof
+        t0 = time.perf_counter() if prof is not None else 0.0
 
         with self._lock:
             inbox, consumed = self._build_inbox()
@@ -415,6 +444,10 @@ class BatchedRawNode:
                 (min(len(q), cfg.max_props_per_round) for q in self._props),
                 np.int32, count=self.n,
             )
+        if prof is not None:
+            t1 = time.perf_counter()
+            prof["inbox"] += t1 - t0
+            t0 = t1
 
         st, outbox, aux = self._step(
             self.state, inbox,
@@ -435,6 +468,10 @@ class BatchedRawNode:
             aux.last_tick,
         ])
         out_np = jax.device_get(outbox)
+        if prof is not None:
+            t1 = time.perf_counter()
+            prof["step"] += t1 - t0
+            t0 = t1
 
         term = term.astype(np.int64)
         vote = vote.astype(np.int64)
@@ -526,10 +563,19 @@ class BatchedRawNode:
                 if items:
                     committed.append((int(row), items))
 
+            if prof is not None:
+                t1 = time.perf_counter()
+                prof["post"] += t1 - t0
+                t0 = t1
+
             # -- outbound messages (MsgApp payloads come from the arena)
-            messages = self._collect_messages(
+            msg_block, messages = self._collect_messages(
                 out_np, ring64, snap_i, last, term, commit
             )
+            if prof is not None:
+                t1 = time.perf_counter()
+                prof["collect"] += t1 - t0
+                prof["rounds"] += 1
 
         must_sync = bool(
             entries
@@ -568,6 +614,7 @@ class BatchedRawNode:
             committed=committed,
             messages=messages,
             must_sync=must_sync,
+            msg_block=msg_block,
             read_states=read_states,
             read_opened=read_opened,
         )
@@ -637,6 +684,15 @@ class BatchedRawNode:
                 ent_terms[row, s, lane, j] = ent.term
         for key in dead:
             del self._pending[key]
+        if self._blocks:
+            residual = merge_blocks(
+                list(self._blocks), r, NUM_KINDS,
+                {"valid": valid, "type": typ, "term": term,
+                 "log_term": log_term, "index": index, "commit": commit,
+                 "reject": reject, "reject_hint": reject_hint, "ctx": ctx},
+            )
+            consumed += 1  # at least one block drained
+            self._blocks = deque(residual)
         inbox = MsgSlots(
             valid=jnp.asarray(valid), type=jnp.asarray(typ),
             term=jnp.asarray(term), log_term=jnp.asarray(log_term),
@@ -648,10 +704,15 @@ class BatchedRawNode:
         return inbox, consumed
 
     def _collect_messages(self, out, ring64, snap_i, last, term, commit):
-        """outbox slots → Message objects (payloads re-attached)."""
+        """outbox slots → one SoA block for the payload-free majority +
+        Message objects for MsgApp-with-entries / MsgSnap (payloads
+        re-attached from the arena)."""
         w = self.cfg.window
+        block, complex_mask = collect_block(
+            np.asarray(out.valid), out, self.slots
+        )
         msgs: List[Tuple[int, Message]] = []
-        rows, targets, kinds = np.nonzero(np.asarray(out.valid))
+        rows, targets, kinds = np.nonzero(complex_mask)
         for row, tgt, k in zip(rows, targets, kinds):
             t = int(out.type[row, tgt, k])
             m = Message(
@@ -694,7 +755,7 @@ class BatchedRawNode:
                     )
                 )
             msgs.append((int(row), m))
-        return msgs
+        return block, msgs
 
     # -- introspection ---------------------------------------------------------
 
