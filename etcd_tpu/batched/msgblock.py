@@ -73,6 +73,34 @@ for _t, _lane in (
     LANE_OF[_t] = _lane
 
 
+def validate_records(rec: np.ndarray, n_rows: int,
+                     num_replicas: int) -> np.ndarray:
+    """Filter wire-controlled block records down to the well-formed
+    subset; the rest are dropped, matching the object path's
+    corrupt-frame-drop semantics (hosting.py decode).
+
+    A record is well-formed iff row < n_rows, 1 <= frm <= R,
+    lane < NUM_KINDS and lane == LANE_OF[type]. Anything else would
+    index the dense inbox out of range (crashing the member's round
+    loop) or — worse, for frm=0 — wrap to a negative flat index and
+    silently forge a message into a DIFFERENT group's inbox slot.
+    """
+    if len(rec) == 0:
+        return rec
+    typ = rec["type"]
+    # T_SNAP never legitimately rides a block (collect_block keeps it
+    # on the object path, where hosting restores app state and WAL-logs
+    # the snapshot BEFORE the device sees it); a forged one here would
+    # fast-forward raft state past entries whose data never arrived.
+    ok = (
+        (rec["row"] < n_rows)
+        & (rec["frm"] >= 1) & (rec["frm"] <= num_replicas)
+        & (typ < _MAX_T) & (typ != T_SNAP)
+        & (rec["lane"] == LANE_OF[np.minimum(typ, _MAX_T - 1)])
+    )
+    return rec if ok.all() else rec[ok]
+
+
 class MsgBlock:
     """A batch of payload-free messages as one structured array."""
 

@@ -48,7 +48,12 @@ from ..raft.types import (
     Snapshot,
     SnapshotMetadata,
 )
-from .msgblock import MsgBlock, collect_block, merge_blocks
+from .msgblock import (
+    MsgBlock,
+    collect_block,
+    merge_blocks,
+    validate_records,
+)
 from .state import BatchedConfig, BatchedState, LEADER, I32, init_state
 from .step import (
     KIND_APP,
@@ -386,11 +391,19 @@ class BatchedRawNode:
 
     def step_block(self, blk: MsgBlock) -> None:
         """Stage a batch of payload-free inbound messages (the SoA wire
-        fast path — see msgblock.py). One lock acquisition per batch."""
-        if len(blk) == 0:
+        fast path — see msgblock.py). One lock acquisition per batch.
+
+        Records are validated HERE, at ingest: row/frm/lane/type come
+        straight off the wire, and a malformed record would otherwise
+        crash the round loop (IndexError in _build_inbox) or scatter a
+        forged message into another group's inbox slot via negative
+        flat-index wraparound. Invalid records are dropped, matching
+        the object path's corrupt-frame-drop semantics."""
+        rec = validate_records(blk.rec, self.n, self.cfg.num_replicas)
+        if len(rec) == 0:
             return
         with self._lock:
-            self._blocks.append(blk.rec)
+            self._blocks.append(rec)
 
     def install_snapshot_state(self, row: int, index: int,
                                applied_data_restored: bool = True) -> None:
@@ -430,7 +443,7 @@ class BatchedRawNode:
         t0 = time.perf_counter() if prof is not None else 0.0
 
         with self._lock:
-            inbox, consumed = self._build_inbox()
+            inbox = self._build_inbox()
             ticks = self._ticks > 0
             self._ticks = np.maximum(self._ticks - 1, 0)
             camp = self._campaign.copy()
@@ -644,9 +657,24 @@ class BatchedRawNode:
 
     # -- internals -------------------------------------------------------------
 
+    # Residual block records are bounded: raft tolerates message loss,
+    # so once the residual queue exceeds this many records per inbox
+    # key on average, the OLDEST blocks are dropped (a key contested by
+    # a busy object-path append stream would otherwise accumulate
+    # residuals without bound — ADVICE r04).
+    _RESIDUAL_RECORDS_PER_KEY = 4
+
     def _build_inbox(self):
         """Pop at most one pending message per (row, sender, lane) into
-        a dense inbox. Caller holds _lock."""
+        a dense inbox. Caller holds _lock.
+
+        Object-path messages are drained BEFORE queued blocks, so a
+        block record can be overtaken by a later object-path message
+        for the same (row, sender, lane). That cross-channel reordering
+        is intentional — it mirrors the reference's two rafthttp
+        channels, which give no cross-channel ordering either (ref:
+        server/etcdserver/api/rafthttp/peer.go:337-349); raft tolerates
+        reordering and loss on every link."""
         cfg = self.cfg
         r, e = cfg.num_replicas, cfg.max_ents_per_msg
         shape = (self.n, r, NUM_KINDS)
@@ -661,12 +689,10 @@ class BatchedRawNode:
         n_ents = np.zeros(shape, np.int32)
         ctx = np.zeros(shape, np.int32)
         ent_terms = np.zeros(shape + (e,), np.int32)
-        consumed = 0
         dead = []
         for key, q in self._pending.items():
             row, s, lane = key
             m: Message = q.popleft()
-            consumed += 1
             if not q:
                 dead.append(key)
             valid[row, s, lane] = True
@@ -691,7 +717,9 @@ class BatchedRawNode:
                  "log_term": log_term, "index": index, "commit": commit,
                  "reject": reject, "reject_hint": reject_hint, "ctx": ctx},
             )
-            consumed += 1  # at least one block drained
+            cap = self._RESIDUAL_RECORDS_PER_KEY * self.n * r * NUM_KINDS
+            while len(residual) > 1 and sum(map(len, residual)) > cap:
+                residual.pop(0)  # drop oldest whole block (loss is safe)
             self._blocks = deque(residual)
         inbox = MsgSlots(
             valid=jnp.asarray(valid), type=jnp.asarray(typ),
@@ -701,7 +729,7 @@ class BatchedRawNode:
             n_ents=jnp.asarray(n_ents), ctx=jnp.asarray(ctx),
             ent_terms=jnp.asarray(ent_terms),
         )
-        return inbox, consumed
+        return inbox
 
     def _collect_messages(self, out, ring64, snap_i, last, term, commit):
         """outbox slots → one SoA block for the payload-free majority +

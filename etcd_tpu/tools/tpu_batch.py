@@ -1,0 +1,218 @@
+"""Tunnel-live TPU measurement batch (BENCH_NOTES round-5 task).
+
+Fires EVERY open TPU perf question in one run, so a live tunnel window
+is never wasted on a single capture (the round-4 lesson):
+
+  (a) re-capture the G=65536 headline rate (six-lane deliver, the
+      bench.py default config) so the driver record can be confirmed;
+  (b) six-vs-two merged deliver scans ON TPU (CPU favored six 2x;
+      CPU has not predicted TPU for this kernel before);
+  (c) the Pallas fused quorum/ring kernels vs their XLA forms
+      (integration gate, pallas_kernels.py docstring);
+  (d) device-side commit p50 — rounds-to-commit counted by stepping
+      single rounds (correctness only), priced at the per-round wall
+      time of the async multi-round scans, NOT at the tunnel RTT of a
+      single dispatch (the round-4 number was RTT-dominated);
+  (e) an xprof trace of the steady-state round (best effort — the
+      axon remote platform may not support profiling).
+
+Writes artifacts/tpu_r05/batch.json with every number + provenance and
+appends nothing anywhere else (BENCH_NOTES is written by hand from it).
+
+    python -m etcd_tpu.tools.tpu_batch [--groups 65536]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _log(msg: str) -> None:
+    print(f"[tpu_batch {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _make_engine(groups: int, merged: bool):
+    import jax.numpy as jnp
+
+    from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
+
+    cfg = BatchedConfig(
+        num_groups=groups,
+        num_replicas=3,
+        window=32,
+        max_ents_per_msg=4,
+        max_props_per_round=2,
+        election_timeout=1 << 20,
+        heartbeat_timeout=4,
+        auto_compact=True,
+        lanes_minor=True,  # pinned lane-filling layout (bench.py on TPU)
+        merged_deliver=merged,
+    )
+    eng = MultiRaftEngine(cfg)
+    eng.campaign([g * cfg.num_replicas for g in range(groups)])
+    eng.run_rounds(4, tick=False)
+    assert (eng.leaders() == 0).all(), "election failed in batch setup"
+    props = jnp.zeros((cfg.num_instances,), jnp.int32)
+    props = props.at[jnp.arange(groups) * cfg.num_replicas].set(2)
+    return eng, props
+
+
+def _rate(eng, props, rounds_per_call: int = 16, calls: int = 8) -> float:
+    import jax
+
+    eng.run_rounds(rounds_per_call, tick=True, propose_n=props)  # warmup
+    jax.block_until_ready(eng.state.commit)
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        eng.run_rounds(rounds_per_call, tick=True, propose_n=props)
+    jax.block_until_ready(eng.state.commit)
+    dt = time.perf_counter() - t0
+    return eng.cfg.num_groups * rounds_per_call * calls / dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=65536)
+    ap.add_argument("--out", default="artifacts/tpu_r05")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    _log(f"platform={platform} devices={jax.devices()}")
+    os.makedirs(args.out, exist_ok=True)
+    result: dict = {
+        "platform": platform,
+        "device": str(jax.devices()[0]),
+        "groups": args.groups,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "captured_by": "builder (tools/tpu_batch.py)",
+    }
+
+    def flush() -> None:
+        with open(os.path.join(args.out, "batch.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+
+    # ---- (a) headline capture: six-lane deliver, bench.py config ----
+    t0 = time.perf_counter()
+    eng, props = _make_engine(args.groups, merged=False)
+    compile_s = time.perf_counter() - t0
+    _log(f"(a) six-lane G={args.groups} built+compiled in {compile_s:.0f}s")
+    rate_six = _rate(eng, props)
+    commits = eng.commits()
+    assert commits.min() > 0
+    _log(f"(a) six-lane rate: {rate_six:,.0f} group-rounds/s")
+    result["a_six_lane"] = {
+        "rate_group_rounds_per_s": round(rate_six, 1),
+        "compile_s": round(compile_s, 1),
+        "config": "G=%d R=3 W=32 layout=minor merged_deliver=False"
+                  % args.groups,
+        "commits_min": int(commits.min()),
+    }
+    flush()
+
+    # ---- (d) device-side commit p50 ----
+    # rounds-to-commit: counted with single-round steps (each pays a
+    # tunnel RTT but only the ROUND COUNT is used, never the wall time);
+    # priced at the per-round wall time of the pipelined scan above.
+    one = jnp.zeros((eng.cfg.num_instances,), jnp.int32)
+    one = one.at[jnp.arange(args.groups) * eng.cfg.num_replicas].set(1)
+    eng.run_rounds(1, tick=False, propose_n=one)  # warm 1-round program
+    for _ in range(4):
+        eng.run_rounds(1, tick=False)
+    jax.block_until_ready(eng.state.commit)
+    base = int(eng.commits()[:, 0].min())
+    eng.run_rounds(1, tick=False, propose_n=one)
+    rounds_to_commit = 1
+    while int(eng.commits()[:, 0].min()) <= base and rounds_to_commit < 10:
+        eng.run_rounds(1, tick=False)
+        rounds_to_commit += 1
+    timed_out = int(eng.commits()[:, 0].min()) <= base
+    per_round_s = args.groups / rate_six  # seconds per round at steady state
+    p50_us = rounds_to_commit * per_round_s * 1e6
+    _log(f"(d) rounds_to_commit={rounds_to_commit}, per-round "
+         f"{per_round_s*1e6:.1f}us -> device-side commit p50 "
+         f"{p50_us:.1f}us timed_out={timed_out}")
+    result["d_commit_p50"] = {
+        "rounds_to_commit": rounds_to_commit,
+        "timed_out": timed_out,
+        "per_round_us": round(per_round_s * 1e6, 2),
+        "commit_p50_us_device_side": round(p50_us, 2),
+        "note": "round count from single-round stepping (count only); "
+                "priced at steady-state per-round wall time, not tunnel "
+                "RTT",
+    }
+    flush()
+
+    # ---- (e) xprof trace (best effort) ----
+    trace_dir = os.path.join(args.out, "xprof")
+    try:
+        with jax.profiler.trace(trace_dir):
+            eng.run_rounds(16, tick=True, propose_n=props)
+            jax.block_until_ready(eng.state.commit)
+        has_files = any(files for _, _, files in os.walk(trace_dir))
+        result["e_xprof"] = {"ok": has_files, "dir": trace_dir}
+        _log(f"(e) xprof trace saved={has_files} -> {trace_dir}")
+    except Exception as e:  # noqa: BLE001 — profiling is best-effort
+        result["e_xprof"] = {"ok": False, "error": repr(e)}
+        _log(f"(e) xprof failed: {e!r}")
+    flush()
+    del eng, props
+
+    # ---- (c) Pallas kernels vs XLA forms ----
+    try:
+        from etcd_tpu.tools import pallas_bench
+
+        import contextlib
+        import io
+
+        saved_argv = sys.argv
+        buf = io.StringIO()
+        try:
+            sys.argv = ["pallas_bench"]
+            with contextlib.redirect_stdout(buf):
+                pallas_bench.main()
+        finally:
+            sys.argv = saved_argv
+        result["c_pallas"] = {"ok": True, "report": buf.getvalue()}
+        _log("(c) pallas_bench:\n" + buf.getvalue())
+    except Exception as e:  # noqa: BLE001 — keep the batch going
+        result["c_pallas"] = {"ok": False, "error": repr(e)}
+        _log(f"(c) pallas_bench failed: {e!r}")
+    flush()
+
+    # ---- (b) merged two-scan deliver shape ----
+    try:
+        t0 = time.perf_counter()
+        eng2, props2 = _make_engine(args.groups, merged=True)
+        compile2_s = time.perf_counter() - t0
+        _log(f"(b) merged G={args.groups} built+compiled in "
+             f"{compile2_s:.0f}s")
+        rate_merged = _rate(eng2, props2)
+        assert eng2.commits().min() > 0
+        _log(f"(b) merged rate: {rate_merged:,.0f} group-rounds/s "
+             f"({rate_merged / rate_six:.2f}x six-lane)")
+        result["b_merged_deliver"] = {
+            "rate_group_rounds_per_s": round(rate_merged, 1),
+            "compile_s": round(compile2_s, 1),
+            "vs_six_lane": round(rate_merged / rate_six, 3),
+        }
+        del eng2, props2
+    except Exception as e:  # noqa: BLE001
+        result["b_merged_deliver"] = {"ok": False, "error": repr(e)}
+        _log(f"(b) merged deliver failed: {e!r}")
+    flush()
+
+    _log("batch complete")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
