@@ -160,6 +160,15 @@ class BatchedNode:
         with self._lock:
             return self._conf_tracker.conf_state()
 
+    def _self_tracked(self) -> bool:
+        """Whether this member has a progress entry in the current
+        config (voter of either half or learner) — the reference's
+        condition for a leader to accept proposals (raft.go:1043)."""
+        with self._lock:
+            cs = self._conf_tracker.conf_state()
+        return self.id in set(cs.voters) | set(
+            cs.voters_outgoing) | set(cs.learners)
+
     # -- Node interface --------------------------------------------------------
 
     def tick(self) -> None:
@@ -179,6 +188,14 @@ class BatchedNode:
         deadline = time.monotonic() + (timeout if timeout else 5.0)
         while True:
             if self.rn.is_leader(0):
+                if not self._self_tracked():
+                    # A leader removed from the config drops proposals
+                    # (ref: raft.go:1043-1046 "not currently a member
+                    # of the range"); the device propose gate refuses
+                    # them too, so queueing would pend forever.
+                    raise ProposalDroppedError(
+                        "raft proposal dropped: leader removed from "
+                        "config")
                 self.rn.propose(0, data, etype=int(etype))
                 self._work.set()
                 return
@@ -249,6 +266,12 @@ class BatchedNode:
             learners=[v - 1 for v in cs.learners],
             joint=bool(cs.voters_outgoing),
         )
+        if self.rn.is_leader(0):
+            # A leader contacts changed membership immediately
+            # (ref: raft.go switchToConfig → maybeSendAppend), not at
+            # the next heartbeat timeout — a joiner's catch-up must not
+            # depend on tick cadence.
+            self.rn.poke_append(0)
         if auto_leave and self.rn.is_leader(0):
             # The leader auto-proposes the empty change that exits an
             # implicit joint config (ref: raft.go advance() proposing
@@ -272,6 +295,13 @@ class BatchedNode:
             # Forwarded proposal: accept if we lead, else re-forward once
             # more toward our view of the leader; drop without one.
             if self.rn.is_leader(0):
+                if not self._self_tracked():
+                    # Same gate as the local propose path: the device
+                    # refuses appends from an untracked leader, so
+                    # queueing would pend (and spin has_work) forever.
+                    raise ProposalDroppedError(
+                        "raft proposal dropped: leader removed from "
+                        "config")
                 for e in m.entries:
                     # Entry types survive forwarding (a follower's conf
                     # change must commit as EntryConfChange).
@@ -347,7 +377,9 @@ class BatchedNode:
         pass
 
     def has_ready(self) -> bool:
-        return self.rn.has_work()
+        with self._lock:
+            fwd = bool(self._fwd)
+        return fwd or self.rn.has_work()
 
     def ready(self, timeout: Optional[float] = None) -> Optional[Ready]:
         """Run one device round over the staged inputs and translate the
@@ -425,7 +457,8 @@ class BatchedNode:
             all_msgs.extend(block_messages(rd.msg_block))
         for _row, m in all_msgs:
             if int(m.type) == T_SNAP:
-                app = self._app_snap
+                with self._lock:
+                    app = self._app_snap
                 if app is None or app.metadata.index < m.snapshot.metadata.index:
                     # Floor moved without a matching app snapshot (only
                     # possible transiently); retry next heartbeat.
@@ -512,6 +545,18 @@ class BatchedNode:
         floor there and keep the snapshot for lagging followers."""
         self._app_snap = snapshot
         self.rn.compact(0, index)
+
+    def set_app_snapshot(self, snapshot: Snapshot) -> None:
+        """Refresh the app snapshot backing outbound MsgSnap without
+        moving the log floor — hosts that apply continuously keep this
+        at their applied watermark so stragglers restore to the newest
+        state (the snapOverrideStorage shape,
+        ref: rafttest/interaction_env_handler_add_nodes.go)."""
+        with self._lock:
+            if (self._app_snap is None
+                    or snapshot.metadata.index
+                    >= self._app_snap.metadata.index):
+                self._app_snap = snapshot
 
     def status(self) -> Status:
         role = int(self.rn.m_role[0])

@@ -216,6 +216,8 @@ class BatchedRawNode:
         self._isolate = np.zeros(self.n, bool)
         self._transfer = np.zeros(self.n, np.int32)  # target slot+1
         self._read_req = np.zeros(self.n, bool)
+        self._poked = False  # host staged send_append flags (poke_append)
+        self._poke_rows = np.zeros(self.n, bool)
         self._read_seen = np.zeros(self.n, np.int64)  # last surfaced seq
         self._read_seq_prev = np.zeros(self.n, np.int64)  # open detection
         self._snap_staged: Dict[int, Tuple[int, int]] = {}  # row->(idx,term)
@@ -421,7 +423,7 @@ class BatchedRawNode:
     def has_work(self) -> bool:
         with self._lock:
             if (
-                self._pending or self._blocks
+                self._pending or self._blocks or self._poked
                 or self._ticks.any()
                 or self._campaign.any()
                 or self._transfer.any()
@@ -453,6 +455,11 @@ class BatchedRawNode:
             self._transfer[:] = 0
             read_req = self._read_req.copy()
             self._read_req[:] = False
+            poke_rows = (
+                np.nonzero(self._poke_rows)[0] if self._poked else None
+            )
+            self._poke_rows[:] = False
+            self._poked = False
             props_n = np.fromiter(
                 (min(len(q), cfg.max_props_per_round) for q in self._props),
                 np.int32, count=self.n,
@@ -462,6 +469,14 @@ class BatchedRawNode:
             prof["inbox"] += t1 - t0
             t0 = t1
 
+        if poke_rows is not None and len(poke_rows):
+            # Host-staged bcastAppend (poke_append), applied here on
+            # the round thread — the only writer of self.state.
+            st0 = self.state
+            self.state = st0._replace(
+                send_append=st0.send_append.at[jnp.asarray(poke_rows)]
+                .set(True)
+            )
         st, outbox, aux = self._step(
             self.state, inbox,
             jnp.asarray(ticks), jnp.asarray(camp),
@@ -812,6 +827,18 @@ class BatchedRawNode:
         self.m_snap[row] = max(self.m_snap[row], idx)
         if self._round is not None:
             self._round[6][row] = max(self._round[6][row], idx)
+
+    def poke_append(self, row: int) -> None:
+        """Stage an immediate append/probe to every replication target
+        of `row` — the device twin of the leader's bcastAppend on a
+        config change (ref: raft.go switchToConfig → maybeSendAppend):
+        a newly admitted member must be contacted now, not at the next
+        heartbeat timeout. Staged host-side and applied to device state
+        at the head of the next advance_round (on the round thread), so
+        callers on other threads never race the round's state swap."""
+        with self._lock:
+            self._poke_rows[row] = True
+            self._poked = True
 
     def leader_rows(self) -> np.ndarray:
         return np.nonzero(self.m_role == LEADER)[0]

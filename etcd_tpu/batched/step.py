@@ -941,8 +941,12 @@ def _propose(cfg: BatchedConfig, slot, st: BatchedState, n_new):
     r = cfg.num_replicas
     peers = jnp.arange(r, dtype=I32)
     # Proposals are dropped while a leadership transfer is in flight
-    # (ref: raft.go:1048-1053 ErrProposalDropped on leadTransferee).
-    is_leader = (st.role == LEADER) & (st.transferee == 0)
+    # (ref: raft.go:1048-1053 ErrProposalDropped on leadTransferee) and
+    # on a leader that has been removed from the config — no progress
+    # for self means no proposals (ref: raft.go:1043-1046
+    # "not currently a member of the range").
+    self_tracked = _pick_b(_repl_targets(st), peers == slot)
+    is_leader = (st.role == LEADER) & (st.transferee == 0) & self_tracked
     headroom = jnp.maximum(
         cfg.window - (st.last - st.snap_index) - cfg.max_props_per_round, 0
     )
