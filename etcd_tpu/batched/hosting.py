@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue as queue_mod
 import struct
 import threading
 import time
@@ -128,6 +129,7 @@ class MultiRaftMember:
         cfg: Optional[BatchedConfig] = None,
         tick_interval: float = 0.02,
         send_fn: Optional[Callable[[int, List[Tuple[int, Message]]], None]] = None,
+        pipeline: bool = True,
     ) -> None:
         self.id = member_id
         self.slot = member_id - 1
@@ -156,6 +158,11 @@ class MultiRaftMember:
         # support it set this, others get the object fallback.
         self._send_block: Optional[Callable[[int, "object"], None]] = None
         self._lock = threading.Lock()
+        self._work = threading.Event()  # wakes the round loop
+        # Wall-seconds per phase of the member pipeline (ETCD_TPU_PROF
+        # companion at the hosting layer; read via the admin 'prof' op).
+        self.stats = {"rounds": 0, "round_s": 0.0, "wal_s": 0.0,
+                      "apply_s": 0.0, "send_s": 0.0, "batched": 0}
         self.tick_interval = tick_interval
         # ReadIndex bookkeeping for linearizable readers: the latest
         # OPENED batch seq per group (readers bind to a batch opened
@@ -188,10 +195,25 @@ class MultiRaftMember:
         self._stopped = threading.Event()
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
         self._runner = threading.Thread(target=self._run_loop, daemon=True)
+        # Ready pipeline: the round thread hands each BatchedReady to a
+        # persist/apply/send worker so the NEXT device round overlaps
+        # this round's WAL fsync + apply + TCP send (the reference's
+        # overlap, ref: server/etcdserver/raft.go:218-268). Bounded:
+        # a slow disk backpressures the round loop after 4 rounds, so a
+        # crash loses at most the queued (unacknowledged) suffix and no
+        # message ever escapes before its round's fsync (ordered queue,
+        # batch fsync covers every append before any send).
+        self._ready_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=4)
+        self._drainer: Optional[threading.Thread] = (
+            threading.Thread(target=self._drain_loop, daemon=True)
+            if pipeline else None
+        )
 
     def start(self) -> None:
         self._ticker.start()
         self._runner.start()
+        if self._drainer is not None:
+            self._drainer.start()
 
     # -- boot ------------------------------------------------------------------
 
@@ -235,28 +257,85 @@ class MultiRaftMember:
     def _tick_loop(self) -> None:
         while not self._stopped.wait(self.tick_interval):
             self.rn.tick()
+            self._work.set()
 
     def _run_loop(self) -> None:
+        # Event-driven: staged work (proposals, inbound messages,
+        # ticks) wakes the loop immediately instead of a blind sleep —
+        # a put proposed mid-sleep otherwise pays up to a quarter tick
+        # of dead latency PER HOP of the commit path.
         while not self._stopped.is_set():
             if not self.rn.has_work():
-                time.sleep(self.tick_interval / 4)
+                self._work.wait(self.tick_interval)
+                self._work.clear()
                 continue
             self.run_round()
 
+    def _drain_loop(self) -> None:
+        """Persist/apply/send worker: drains Readys in round order,
+        coalescing everything queued into ONE WAL fsync before any of
+        their messages go out (the reference overlaps the next raft
+        Ready with storage/apply the same way — raft.go:218-268 — and
+        wal.Save batches; fsync-before-send holds per round because the
+        queue is ordered and the sync covers every appended record)."""
+        while True:
+            rd = self._ready_q.get()
+            if rd is None:
+                return
+            batch = [rd]
+            while True:
+                try:
+                    nxt = self._ready_q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if nxt is None:
+                    self._process_readys(batch)
+                    return
+                batch.append(nxt)
+            self._process_readys(batch)
+
     def run_round(self) -> BatchedReady:
-        """One Ready cycle for all groups: device round → WAL fsync →
-        apply → send (snapshots attached at current applied state) →
-        advance."""
+        """One device round; the Ready's persist/apply/send runs on the
+        drain worker (pipelined with the next device round), unless the
+        member runs unpipelined (pipeline=False: synchronous — kept as
+        a debugging/fallback mode and covered by the test_hosting
+        'sync' cluster parametrization)."""
+        t0 = time.perf_counter()
         rd = self.rn.advance_round()
+        self.rn.advance()
+        self.stats["rounds"] += 1
+        self.stats["round_s"] += time.perf_counter() - t0
+        if self._drainer is not None:
+            self._ready_q.put(rd)  # bounded: backpressure on the round
+        else:
+            self._process_readys([rd])
+        return rd
+
+    def _process_readys(self, batch: List[BatchedReady]) -> None:
+        """Persist (one fsync for the whole batch) → apply → send, in
+        round order."""
+        t0 = time.perf_counter()
         with self._lock:
-            # 1. persist (one fsync for every group)
-            for row, term, vote, commit in rd.hardstates:
-                self.wal.append(RT_HARDSTATE, _pack_hs(row, term, vote, commit))
-            for row, i, t, d, et in rd.entries:
-                self.wal.append(RT_ENTRY, _pack_entry(row, i, t, d, et))
-            if rd.must_sync:
+            must_sync = False
+            for rd in batch:
+                for row, term, vote, commit in rd.hardstates:
+                    self.wal.append(
+                        RT_HARDSTATE, _pack_hs(row, term, vote, commit))
+                for row, i, t, d, et in rd.entries:
+                    self.wal.append(RT_ENTRY, _pack_entry(row, i, t, d, et))
+                must_sync |= rd.must_sync
+            if must_sync:
                 self.wal.flush(sync=True)
-            # 2. apply committed payloads
+        self.stats["wal_s"] += time.perf_counter() - t0
+        self.stats["batched"] += len(batch)
+        for rd in batch:
+            self._apply_and_send(rd)
+
+    def _apply_and_send(self, rd: BatchedReady) -> None:
+        t0 = time.perf_counter()
+        with self._lock:
+            # 2. apply committed payloads (persist already happened in
+            #    _process_readys; the batch fsync precedes every send)
             for row, items in rd.committed:
                 for i, _t, d, et in items:
                     # Conf-change entries are membership, not KV data
@@ -269,18 +348,22 @@ class MultiRaftMember:
             #     host's applied watermark, ≥ the device floor after
             #     step 2; the floor metadata rides in m.index/log_term)
             out: List[Tuple[int, Message]] = []
-            ring = self.rn.latest_ring()
             w = self.cfg.window
             for row, m in rd.messages:
                 if int(m.type) == T_SNAP:
                     idx = int(self.applied_index[row])
-                    # Term at the applied watermark: from the ring above
-                    # the floor, else the floor term riding in the
-                    # message (m.log_term) — the receiver persists it
-                    # and restores its ring floor from it.
+                    # Term at the applied watermark, from THIS round's
+                    # ring row (captured in the Ready): the drain
+                    # worker may run rounds behind the device, and the
+                    # live ring slot could have wrapped to a different
+                    # entry by now. Below/at the floor, the floor term
+                    # rides in the message (m.log_term) — the receiver
+                    # persists it and restores its ring floor from it.
+                    ring_row = rd.snap_rings.get(row)
                     t = (
-                        int(ring[row, idx % w])
-                        if idx > m.index else m.log_term
+                        int(ring_row[idx % w])
+                        if idx > m.index and ring_row is not None
+                        else m.log_term
                     )
                     m.snapshot = Snapshot(
                         metadata=SnapshotMetadata(index=idx, term=t),
@@ -296,6 +379,8 @@ class MultiRaftMember:
                 for row, seq, idx in rd.read_states:
                     self._read_results[row] = (seq, idx)
                 self._read_cv.notify_all()
+        t1 = time.perf_counter()
+        self.stats["apply_s"] += t1 - t0
         # 3b. send OUTSIDE the lock: delivery takes the receiver's lock,
         #     and two members sending to each other must not deadlock.
         if out and self._send is not None:
@@ -308,9 +393,7 @@ class MultiRaftMember:
                 from .msgblock import block_messages
 
                 self._send(self.id, block_messages(blk))
-        # 4. advance
-        self.rn.advance()
-        return rd
+        self.stats["send_s"] += time.perf_counter() - t1
 
     # -- wire ------------------------------------------------------------------
 
@@ -337,6 +420,7 @@ class MultiRaftMember:
                     )
                     self.wal.flush(sync=True)
         self.rn.step(group, m)
+        self._work.set()
 
     def deliver_block(self, blk) -> None:
         """Batch entry point: payload-free messages as one SoA block
@@ -344,6 +428,7 @@ class MultiRaftMember:
         if self._stopped.is_set():
             return
         self.rn.step_block(blk)
+        self._work.set()
 
     # -- API -------------------------------------------------------------------
 
@@ -354,6 +439,7 @@ class MultiRaftMember:
         if not self.rn.is_leader(group):
             return False
         self.rn.propose(group, payload)
+        self._work.set()
         return True
 
     def leader_of(self, group: int) -> int:
@@ -365,6 +451,18 @@ class MultiRaftMember:
 
     def campaign(self, groups) -> None:
         self.rn.campaign(np.asarray(groups))
+        self._work.set()
+
+    def transfer_leader(self, group: int, target_member: int) -> bool:
+        """Hand leadership of `group` to `target_member` (slot+1) —
+        the admin rebalancing primitive; campaigns cannot displace a
+        healthy leader under pre-vote/check-quorum, transfers can
+        (ref: raft.go:1339 MsgTransferLeader, campaignTransfer)."""
+        if not self.rn.is_leader(group):
+            return False
+        self.rn.transfer_leader(group, target_member - 1)
+        self._work.set()
+        return True
 
     def get(self, group: int, key: bytes) -> Optional[bytes]:
         """Serializable read from local applied state."""
@@ -434,9 +532,21 @@ class MultiRaftMember:
         for t in (self._ticker, self._runner):
             if t.is_alive() and t is not threading.current_thread():
                 t.join(timeout=5)
+        drainer_done = True
+        if self._drainer is not None and self._drainer.is_alive():
+            self._ready_q.put(None)  # drain everything queued, then exit
+            if self._drainer is not threading.current_thread():
+                self._drainer.join(timeout=60)
+                drainer_done = not self._drainer.is_alive()
         with self._lock:
             self.wal.flush(sync=True)
-            self.wal.close()
+            if drainer_done:
+                # Never close the WAL under a live drain worker — its
+                # next append would hit a closed file and silently drop
+                # the queued rounds' persistence. Leaving it open on a
+                # wedged drain is safe: process exit closes the fd and
+                # the CRC chain ends at the last completed record.
+                self.wal.close()
 
 
 class InProcRouter:
@@ -504,17 +614,25 @@ class TCPRouter:
     exactly like InProcRouter; senders drop-don't-block (ref:
     etcdserver/raft.go:108-111)."""
 
-    MAX_PENDING = 4096
+    MAX_PENDING = 16384
     BLOCK_SENTINEL = 0xFFFFFFFF  # group-id marker for SoA block frames
+    # Per-peer sender lanes (PriorityQueue; FIFO within a lane via the
+    # monotone sequence number). Liveness traffic — the SoA block
+    # frames carrying heartbeats/acks/votes — outranks bulk MsgApp
+    # streams so queue pressure never churns leadership; stop outranks
+    # everything so shutdown can't wedge behind a full bulk backlog.
+    PRIO_STOP, PRIO_LIVE, PRIO_BULK = 0, 1, 2
 
     def __init__(self, member: MultiRaftMember,
                  bind: Tuple[str, int] = ("127.0.0.1", 0)) -> None:
+        import itertools
         import socket
 
         from ..transport.codec import MAX_FRAME, decode_message, \
             encode_message
 
         self._socket = socket
+        self._seq = itertools.count()  # FIFO tiebreak within a lane
         self._enc, self._dec = encode_message, decode_message
         self._max_frame = MAX_FRAME
         self.member = member
@@ -560,7 +678,8 @@ class TCPRouter:
             if q2 is None:
                 continue
             try:
-                q2.put_nowait((group, m))
+                q2.put_nowait((self.PRIO_BULK, next(self._seq),
+                               (group, m)))
             except _q.Full:  # drop, never block the round loop
                 pass
 
@@ -586,7 +705,12 @@ class TCPRouter:
             frame = struct.pack(
                 "<II", len(body) + 4, self.BLOCK_SENTINEL) + body
             try:
-                q2.put_nowait(frame)
+                # Blocks (heartbeats/acks/votes) jump the bulk queue:
+                # a queue full of MsgApp resends must never starve the
+                # liveness traffic, or followers churn leadership under
+                # load — the rafthttp two-channel priority
+                # (ref: server/etcdserver/api/rafthttp/peer.go:337-349).
+                q2.put_nowait((self.PRIO_LIVE, next(self._seq), frame))
             except _q.Full:  # drop, never block the round loop
                 pass
 
@@ -600,7 +724,7 @@ class TCPRouter:
             addr = self._addrs.get(to)
             if addr is None:
                 return None
-            q: "_q.Queue" = _q.Queue(maxsize=self.MAX_PENDING)
+            q: "_q.Queue" = _q.PriorityQueue(maxsize=self.MAX_PENDING)
             t = threading.Thread(
                 target=self._sender, args=(to, addr, q), daemon=True)
             self._peers[to] = (q, t)
@@ -611,7 +735,7 @@ class TCPRouter:
     def _sender(self, peer_id: int, addr: Tuple[str, int], q) -> None:
         sock = None
         while not self._stopped.is_set():
-            item = q.get()
+            _prio, _seq, item = q.get()
             if item is None:
                 break
             if isinstance(item, bytes):  # pre-encoded block frame
@@ -744,7 +868,7 @@ class TCPRouter:
                 pass
         for q, t in peers:
             try:
-                q.put_nowait(None)
+                q.put_nowait((self.PRIO_STOP, next(self._seq), None))
             except Exception:  # noqa: BLE001
                 pass
         for _q2, t in peers:
@@ -756,12 +880,14 @@ class MultiRaftCluster:
 
     def __init__(self, data_dir: str, num_members: int = 3,
                  num_groups: int = 16,
-                 cfg: Optional[BatchedConfig] = None) -> None:
+                 cfg: Optional[BatchedConfig] = None,
+                 pipeline: bool = True) -> None:
         self.router = InProcRouter()
         self.members: Dict[int, MultiRaftMember] = {}
         for mid in range(1, num_members + 1):
             m = MultiRaftMember(
-                mid, num_members, num_groups, data_dir, cfg=cfg
+                mid, num_members, num_groups, data_dir, cfg=cfg,
+                pipeline=pipeline,
             )
             self.router.attach(m)
             self.members[mid] = m

@@ -135,6 +135,12 @@ class BatchedReady:
     # the open batch so a later waiter is never served an earlier
     # batch's (stale) index.
     read_opened: List[Tuple[int, int]] = field(default_factory=list)
+    # Ring term rows captured AT ROUND TIME for rows with outbound
+    # MsgSnap: a pipelined drain worker processing this Ready later
+    # must price the snapshot term from THIS round's ring — by then
+    # latest_ring() reflects newer rounds and (with auto_compact) the
+    # slot may have wrapped to a different entry's term.
+    snap_rings: Dict[int, np.ndarray] = field(default_factory=dict)
 
     def contains_updates(self) -> bool:
         return bool(
@@ -485,17 +491,24 @@ class BatchedRawNode:
         )
         self.state = st
 
-        # One bulk device→host transfer.
+        # Device→host reads go through np.asarray, NOT jax.device_get:
+        # this build's device_get pays a fixed ~4ms per buffer (measured
+        # BENCH_NOTES r05 — 27 buffers made the round ~350ms, 100x the
+        # 1.2ms step program), while np.asarray is a zero-copy view on
+        # CPU and a plain single-buffer fetch elsewhere.
+        jax.block_until_ready(st.term)
         (term, vote, commit, last, role, lead, snap_i, snap_t, ring,
          rd_seq, rd_idx, rd_ready,
-         mid_seq, mid_idx, mid_ready, last_tick) = jax.device_get([
-            st.term, st.vote, st.commit, st.last, st.role, st.lead,
-            st.snap_index, st.snap_term, st.log_term,
-            st.read_seq, st.read_index, st.read_ready,
-            aux.read_seq, aux.read_index, aux.read_ready,
-            aux.last_tick,
-        ])
-        out_np = jax.device_get(outbox)
+         mid_seq, mid_idx, mid_ready, last_tick) = [
+            np.asarray(x) for x in (
+                st.term, st.vote, st.commit, st.last, st.role, st.lead,
+                st.snap_index, st.snap_term, st.log_term,
+                st.read_seq, st.read_index, st.read_ready,
+                aux.read_seq, aux.read_index, aux.read_ready,
+                aux.last_tick,
+            )
+        ]
+        out_np = jax.tree.map(np.asarray, outbox)
         if prof is not None:
             t1 = time.perf_counter()
             prof["step"] += t1 - t0
@@ -635,6 +648,10 @@ class BatchedRawNode:
 
         self._round = (term, vote, commit, last, role, lead,
                        snap_i.astype(np.int64), ring64)
+        snap_rings = {
+            row: ring64[row].copy()
+            for row, m in messages if int(m.type) == T_SNAP
+        }
         return BatchedReady(
             hardstates=hardstates,
             entries=entries,
@@ -645,6 +662,7 @@ class BatchedRawNode:
             msg_block=msg_block,
             read_states=read_states,
             read_opened=read_opened,
+            snap_rings=snap_rings,
         )
 
     def advance(self) -> None:

@@ -1,0 +1,234 @@
+"""Hosted-path benchmark: 3 real OS processes, TCPRouter over real
+sockets, G groups on CPU — the service-rate number next to bench.py's
+kernel rate (VERDICT r04 task #1: a per-round artifact with a floor).
+
+Writes HOSTED_BENCH.json at the repo root:
+
+    {"puts_per_sec": ..., "p50_ms": ..., "p99_ms": ...,
+     "n": ..., "groups_led": ..., "restart_catchup_s": ...,
+     "config": "...", "captured_at": "..."}
+
+Run:  python -m etcd_tpu.tools.hosted_bench [--groups 1024] [--n 3000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+MEMBERS = 3
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0):
+    peers = [
+        f"--peer={pid}=127.0.0.1:{raft_ports[pid]}"
+        for pid in range(1, MEMBERS + 1) if pid != mid
+    ]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ETCD_TPU_PROF"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    log = open(os.path.join(data_dir, f"worker-{mid}-gen{gen}.log"), "wb")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "etcd_tpu.batched.hosting_proc",
+            "--id", str(mid), "--members", str(MEMBERS),
+            "--groups", str(groups), "--data-dir", data_dir,
+            "--bind", f"127.0.0.1:{raft_ports[mid]}",
+            "--admin", f"127.0.0.1:{admin_ports[mid]}",
+            "--tick-interval", "0.1",
+        ] + peers,
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+
+
+def main() -> None:
+    from etcd_tpu.batched.hosting_proc import wait_admin
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=1024)
+    ap.add_argument("--n", type=int, default=3000)
+    ap.add_argument("--value-size", type=int, default=64)
+    ap.add_argument("--inflight", type=int, default=4,
+                    help="wave cap per led group")
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    import tempfile
+
+    data_dir = args.data_dir or tempfile.mkdtemp(prefix="hosted-bench-")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out_path = args.out or os.path.join(repo, "HOSTED_BENCH.json")
+
+    raft_p = dict(zip(range(1, MEMBERS + 1), free_ports(MEMBERS)))
+    admin_p = dict(zip(range(1, MEMBERS + 1), free_ports(MEMBERS)))
+    procs, clients = {}, {}
+    try:
+        for mid in range(1, MEMBERS + 1):
+            procs[mid] = spawn(mid, raft_p, admin_p, data_dir,
+                               args.groups)
+        for mid in range(1, MEMBERS + 1):
+            clients[mid] = wait_admin(("127.0.0.1", admin_p[mid]),
+                                      timeout=300.0)
+        # Balanced leadership: group g led by member g%3+1, re-asserted
+        # until it holds STEADY — an unbalanced cluster turns the bench
+        # into a one-member measurement (and a still-settling one loses
+        # proposals to leadership moves mid-run).
+        deadline = time.monotonic() + 300.0
+        nudge = 0.0
+        while time.monotonic() < deadline:
+            leads = clients[1].call(op="leaders")["leads"]
+            misplaced = [g for g, x in enumerate(leads)
+                         if x != g % MEMBERS + 1]
+            if not misplaced:
+                break
+            if time.monotonic() > nudge:
+                for mid, c in clients.items():
+                    # Groups this member should NOT lead but does:
+                    # transfer them to their assigned member. Groups
+                    # with no leader at all: campaign directly.
+                    for target in range(1, MEMBERS + 1):
+                        if target == mid:
+                            continue
+                        mine = [g for g in misplaced
+                                if leads[g] == mid
+                                and g % MEMBERS == target - 1]
+                        if mine:
+                            c.call(op="transfer", groups=mine[:512],
+                                   to=target)
+                    orphans = [g for g in misplaced
+                               if leads[g] == 0
+                               and g % MEMBERS == mid - 1]
+                    if orphans:
+                        c.call(op="campaign", groups=orphans[:512])
+                nudge = time.monotonic() + 3.0
+            time.sleep(0.25)
+        else:
+            raise TimeoutError(f"leadership never balanced "
+                               f"({len(misplaced)} misplaced)")
+        time.sleep(2.0)  # settle
+        for c in clients.values():
+            c.call(op="prof_reset")
+
+        # Aggregate service rate: all three members bench their own
+        # groups CONCURRENTLY (each drives ~G/3 leaders; the cluster's
+        # real offered-load shape, like `benchmark put` with multiple
+        # clients against all endpoints).
+        from concurrent.futures import ThreadPoolExecutor
+
+        from etcd_tpu.batched.hosting_proc import ProcClient
+
+        per = max(args.n // MEMBERS, 1)
+
+        def run_bench(mid):
+            bc = ProcClient(("127.0.0.1", admin_p[mid]), timeout=900.0)
+            try:
+                return bc.call(op="bench", n=per,
+                               value_size=args.value_size,
+                               inflight=args.inflight)
+            finally:
+                bc.close()
+
+        with ThreadPoolExecutor(MEMBERS) as ex:
+            parts = list(ex.map(run_bench, range(1, MEMBERS + 1)))
+        bad = [p for p in parts if not p.get("ok")]
+        if bad:
+            raise RuntimeError(f"bench failed: {bad}")
+        for mid, c in clients.items():
+            prof = c.call(op="prof")
+            print(f"member {mid} prof: {prof.get('stats')}",
+                  file=sys.stderr)
+        # Aggregate: throughputs add (concurrent windows); percentiles
+        # come from the UNION of the members' latency samples.
+        total_done = sum(p["completed"] for p in parts)
+        merged = sorted(
+            x for p in parts for x in p.pop("lat_ms_samples", []))
+        bench = {
+            "ok": True,
+            "n": sum(p["n"] for p in parts),
+            "completed": total_done,
+            "lost": sum(p["lost"] for p in parts),
+            "groups": sum(p["groups"] for p in parts),
+            "puts_per_sec": round(
+                sum(p["puts_per_sec"] for p in parts), 1),
+            "p50_ms": merged[len(merged) // 2] if merged else 0.0,
+            "p99_ms": (merged[max(int(len(merged) * 0.99) - 1, 0)]
+                       if merged else 0.0),
+            "per_member": parts,
+        }
+
+        # Restart catch-up: kill -9 member 3, write under its nose,
+        # restart, time until it serves the missed write.
+        procs[3].kill()
+        procs[3].wait(timeout=10)
+        clients[3].close()
+        g = next(g for g in range(args.groups) if g % MEMBERS == 0)
+        clients[1].call(op="put", g=g, k="Y2F0Y2h1cA==",  # b64 "catchup"
+                        v="MQ==")
+        t0 = time.monotonic()
+        procs[3] = spawn(3, raft_p, admin_p, data_dir, args.groups,
+                         gen=1)
+        clients[3] = wait_admin(("127.0.0.1", admin_p[3]), timeout=300.0)
+        while time.monotonic() - t0 < 180.0:
+            if clients[3].get(g, b"catchup") == b"1":
+                break
+            time.sleep(0.25)
+        else:
+            raise TimeoutError("restarted member did not catch up")
+        catchup_s = time.monotonic() - t0
+
+        result = {
+            "puts_per_sec": bench["puts_per_sec"],
+            "p50_ms": bench["p50_ms"],
+            "p99_ms": bench["p99_ms"],
+            "n": bench["n"],
+            "completed": bench.get("completed", bench["n"]),
+            "lost": bench.get("lost", 0),
+            "groups_led": bench["groups"],
+            "restart_catchup_s": round(catchup_s, 1),
+            "config": (f"G={args.groups} R={MEMBERS} procs={MEMBERS} "
+                       f"value={args.value_size}B "
+                       f"inflight={args.inflight}/group CPU"),
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(json.dumps(result))
+    finally:
+        for c in clients.values():
+            try:
+                c.call(op="stop")
+            except Exception:  # noqa: BLE001
+                pass
+            c.close()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    main()

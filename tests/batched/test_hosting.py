@@ -27,9 +27,12 @@ def wait_until(pred, timeout=20.0, interval=0.02, msg="condition"):
 G = 16
 
 
-@pytest.fixture
-def cluster(tmp_path):
-    c = MultiRaftCluster(str(tmp_path), num_members=3, num_groups=G)
+@pytest.fixture(params=[True, False], ids=["pipelined", "sync"])
+def cluster(tmp_path, request):
+    # Both Ready paths stay covered: the pipelined drain worker
+    # (production default) and the synchronous persist/apply/send.
+    c = MultiRaftCluster(str(tmp_path), num_members=3, num_groups=G,
+                         pipeline=request.param)
     yield c
     c.stop()
 
