@@ -224,6 +224,12 @@ class BatchedRawNode:
         self._read_req = np.zeros(self.n, bool)
         self._poked = False  # host staged send_append flags (poke_append)
         self._poke_rows = np.zeros(self.n, bool)
+        # Staged device-state edits from foreign threads, applied at
+        # the head of the next round ON the round thread (in-place
+        # edits would race the round's state swap): row -> masks, and
+        # row -> requested ring-floor index.
+        self._pending_conf: Dict[int, Tuple] = {}
+        self._pending_compact: Dict[int, int] = {}
         self._read_seen = np.zeros(self.n, np.int64)  # last surfaced seq
         self._read_seq_prev = np.zeros(self.n, np.int64)  # open detection
         self._snap_staged: Dict[int, Tuple[int, int]] = {}  # row->(idx,term)
@@ -326,22 +332,28 @@ class BatchedRawNode:
                        learners=(), joint: bool = False) -> None:
         """Upload new membership masks for one row — the confchange
         apply point (ref: confchange/confchange.go; the host computes
-        slot sets, the device sees only masks). Safe mid-Ready: masks
-        are read by the next round."""
+        slot sets, the device sees only masks).
+
+        STAGED, not applied in place: callers run on apply/transport
+        threads, and a read-modify-write of self.state here races the
+        round thread's state swap in advance_round — the loser's
+        update is silently lost (observed in the wild as a leader whose
+        mask never admitted a new member, leaving the joiner dark
+        forever). Masks are applied at the head of the next round, on
+        the round thread, preserving the documented 'read by the next
+        round' semantics."""
         r = self.cfg.num_replicas
 
-        def mask(slots) -> jnp.ndarray:
-            slots = list(slots)
-            m = jnp.zeros((r,), bool)
-            return m.at[jnp.asarray(slots, I32)].set(True) if slots else m
+        def mask(slots) -> np.ndarray:
+            m = np.zeros((r,), bool)
+            m[list(slots)] = True
+            return m
 
-        st = self.state
-        self.state = st._replace(
-            voter=st.voter.at[row].set(mask(voters)),
-            voter_out=st.voter_out.at[row].set(mask(voters_out)),
-            learner=st.learner.at[row].set(mask(learners)),
-            in_joint=st.in_joint.at[row].set(bool(joint)),
-        )
+        with self._lock:
+            self._pending_conf[row] = (
+                mask(voters), mask(voters_out), mask(learners),
+                bool(joint),
+            )
 
     def transfer_leader(self, row: int, target_slot: int) -> None:
         """Stage a leadership handoff request on a leader row
@@ -430,6 +442,7 @@ class BatchedRawNode:
         with self._lock:
             if (
                 self._pending or self._blocks or self._poked
+                or self._pending_conf or self._pending_compact
                 or self._ticks.any()
                 or self._campaign.any()
                 or self._transfer.any()
@@ -466,6 +479,10 @@ class BatchedRawNode:
             )
             self._poke_rows[:] = False
             self._poked = False
+            pend_conf = self._pending_conf
+            self._pending_conf = {}
+            pend_compact = self._pending_compact
+            self._pending_compact = {}
             props_n = np.fromiter(
                 (min(len(q), cfg.max_props_per_round) for q in self._props),
                 np.int32, count=self.n,
@@ -475,9 +492,39 @@ class BatchedRawNode:
             prof["inbox"] += t1 - t0
             t0 = t1
 
+        # Host-staged device-state edits (membership masks, ring-floor
+        # compaction, bcastAppend pokes), applied here on the round
+        # thread — the only writer of self.state.
+        if pend_conf:
+            st0 = self.state
+            rows2 = np.fromiter(pend_conf, np.int32, len(pend_conf))
+            vin = np.stack([pend_conf[r2][0] for r2 in rows2])
+            vout = np.stack([pend_conf[r2][1] for r2 in rows2])
+            lrn = np.stack([pend_conf[r2][2] for r2 in rows2])
+            jnt = np.fromiter(
+                (pend_conf[r2][3] for r2 in rows2), bool, len(rows2))
+            ridx = jnp.asarray(rows2)
+            self.state = st0._replace(
+                voter=st0.voter.at[ridx].set(jnp.asarray(vin)),
+                voter_out=st0.voter_out.at[ridx].set(jnp.asarray(vout)),
+                learner=st0.learner.at[ridx].set(jnp.asarray(lrn)),
+                in_joint=st0.in_joint.at[ridx].set(jnp.asarray(jnt)),
+            )
+        if pend_compact:
+            for row2, want in pend_compact.items():
+                # No round in flight here (asserted above): the commit
+                # watermark and floor mirrors are current.
+                idx = int(min(want, int(self.m_commit[row2])))
+                if idx <= int(self.m_snap[row2]):
+                    continue
+                t2 = int(self.latest_ring()[row2, idx % cfg.window])
+                st0 = self.state
+                self.state = st0._replace(
+                    snap_index=st0.snap_index.at[row2].set(idx),
+                    snap_term=st0.snap_term.at[row2].set(t2),
+                )
+                self.m_snap[row2] = max(self.m_snap[row2], idx)
         if poke_rows is not None and len(poke_rows):
-            # Host-staged bcastAppend (poke_append), applied here on
-            # the round thread — the only writer of self.state.
             st0 = self.state
             self.state = st0._replace(
                 send_append=st0.send_append.at[jnp.asarray(poke_rows)]
@@ -830,21 +877,15 @@ class BatchedRawNode:
 
     def compact(self, row: int, index: int) -> None:
         """Move the device ring floor to `index` (host took an app
-        snapshot there). Safe mid-Ready: the floor only rises, and
-        advance() merges it with np.maximum."""
-        idx = int(min(index, self.latest_commit(row)))
-        cur = (self._round[6] if self._round is not None else self.m_snap)
-        if idx <= int(cur[row]):
-            return
-        t = int(self.latest_ring()[row, idx % self.cfg.window])
-        st = self.state
-        self.state = st._replace(
-            snap_index=st.snap_index.at[row].set(idx),
-            snap_term=st.snap_term.at[row].set(t),
-        )
-        self.m_snap[row] = max(self.m_snap[row], idx)
-        if self._round is not None:
-            self._round[6][row] = max(self._round[6][row], idx)
+        snapshot there). STAGED like set_membership: the state edit
+        happens at the head of the next round on the round thread (an
+        in-place edit here would race the round's state swap). The
+        floor only rises; the clamp to the committed watermark and the
+        ring-term read happen at apply time, against that round's
+        state."""
+        with self._lock:
+            self._pending_compact[row] = max(
+                self._pending_compact.get(row, 0), int(index))
 
     def poke_append(self, row: int) -> None:
         """Stage an immediate append/probe to every replication target

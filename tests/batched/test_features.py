@@ -387,8 +387,13 @@ class TestConfChangeThroughLog:
             and victim not in confstates[leader_id].voters)
         lead_node = nodes[leader_id]
         import numpy as np
-        assert not bool(np.asarray(
-            lead_node.rn.state.voter[0])[victim - 1])
+        # Mask uploads are STAGED and applied at the head of the next
+        # round (set_membership is called from apply/transport threads;
+        # an in-place device-state edit would race the round thread).
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: not bool(np.asarray(
+                lead_node.rn.state.voter[0])[victim - 1]))
 
         # The 2-voter cluster still commits.
         lead_node.propose(b"two-voter-write")
@@ -405,8 +410,10 @@ class TestConfChangeThroughLog:
             nodes, confstates,
             lambda: confstates.get(leader_id) is not None
             and victim in confstates[leader_id].learners)
-        assert bool(np.asarray(
-            lead_node.rn.state.learner[0])[victim - 1])
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: bool(np.asarray(
+                lead_node.rn.state.learner[0])[victim - 1]))
 
         lead_node.propose_conf_change(ConfChange(
             id=3, type=ConfChangeType.ConfChangeAddNode, node_id=victim))
@@ -414,8 +421,10 @@ class TestConfChangeThroughLog:
             nodes, confstates,
             lambda: confstates.get(leader_id) is not None
             and victim in confstates[leader_id].voters)
-        assert bool(np.asarray(
-            lead_node.rn.state.voter[0])[victim - 1])
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: bool(np.asarray(
+                lead_node.rn.state.voter[0])[victim - 1]))
 
     def test_joint_confchange_v2(self):
         """Explicit-joint V2 change passes through enter/leave joint
@@ -445,7 +454,9 @@ class TestConfChangeThroughLog:
             nodes, confstates,
             lambda: confstates.get(leader_id) is not None
             and bool(confstates[leader_id].voters_outgoing))
-        assert bool(np.asarray(lead_node.rn.state.in_joint)[0])
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: bool(np.asarray(lead_node.rn.state.in_joint)[0]))
 
         # Leave joint.
         lead_node.propose_conf_change(ConfChangeV2())
@@ -454,7 +465,9 @@ class TestConfChangeThroughLog:
             lambda: confstates.get(leader_id) is not None
             and not confstates[leader_id].voters_outgoing
             and victim not in confstates[leader_id].voters)
-        assert not bool(np.asarray(lead_node.rn.state.in_joint)[0])
+        assert self._pump_until(
+            nodes, confstates,
+            lambda: not bool(np.asarray(lead_node.rn.state.in_joint)[0]))
 
 
 class TestReadIndex:
