@@ -130,6 +130,7 @@ class MultiRaftMember:
         tick_interval: float = 0.02,
         send_fn: Optional[Callable[[int, List[Tuple[int, Message]]], None]] = None,
         pipeline: bool = True,
+        mesh_devices: int = 0,
     ) -> None:
         self.id = member_id
         self.slot = member_id - 1
@@ -176,8 +177,23 @@ class MultiRaftMember:
         restore = self._replay()
         groups = np.arange(num_groups, dtype=np.int32)
         slots = np.full(num_groups, self.slot, np.int32)
+        mesh = None
+        if mesh_devices:
+            # Shard this member's [G, ...] state over a device mesh on
+            # the group axis — the multi-chip hosting shape: groups are
+            # data-parallel, quorum reductions stay device-local, WAL/
+            # transport/apply run host-side exactly as unsharded
+            # (SURVEY §2.1; __graft_entry__.dryrun_multichip layout).
+            import jax
+            from jax.sharding import Mesh
+
+            devs = jax.devices()[:mesh_devices]
+            assert len(devs) >= mesh_devices, (
+                f"need {mesh_devices} devices, have {len(jax.devices())}")
+            mesh = Mesh(np.array(devs), ("groups",))
         self.rn = BatchedRawNode(
-            self.cfg, groups=groups, slots=slots, restore=restore
+            self.cfg, groups=groups, slots=slots, restore=restore,
+            mesh=mesh,
         )
         if restore:
             for row, rr in restore.items():
@@ -881,13 +897,14 @@ class MultiRaftCluster:
     def __init__(self, data_dir: str, num_members: int = 3,
                  num_groups: int = 16,
                  cfg: Optional[BatchedConfig] = None,
-                 pipeline: bool = True) -> None:
+                 pipeline: bool = True,
+                 mesh_devices: int = 0) -> None:
         self.router = InProcRouter()
         self.members: Dict[int, MultiRaftMember] = {}
         for mid in range(1, num_members + 1):
             m = MultiRaftMember(
                 mid, num_members, num_groups, data_dir, cfg=cfg,
-                pipeline=pipeline,
+                pipeline=pipeline, mesh_devices=mesh_devices,
             )
             self.router.attach(m)
             self.members[mid] = m

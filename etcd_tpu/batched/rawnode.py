@@ -166,6 +166,7 @@ class BatchedRawNode:
         slots: Optional[np.ndarray] = None,
         restore: Optional[Dict[int, RowRestore]] = None,
         start_index: int = 0,
+        mesh: Optional["object"] = None,
     ) -> None:
         self.cfg = cfg
         r = cfg.num_replicas
@@ -180,12 +181,36 @@ class BatchedRawNode:
         self.slots = slots
         self.n = len(groups)
         iids = groups * r + slots
+        # Row-axis sharding over a device mesh: rows (= groups for a
+        # hosting member) are the data-parallel axis of multi-raft —
+        # quorum reductions stay within a row, so the sharded step
+        # needs NO cross-device collectives (SURVEY §2.1 parallelism
+        # decomposition; the dryrun_multichip layout).
+        self._shard = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            assert self.n % len(mesh.devices.flat) == 0, (
+                f"rows {self.n} must divide the mesh "
+                f"({len(mesh.devices.flat)} devices)")
+            self._shard = NamedSharding(mesh, PartitionSpec("groups"))
+
+        def dev(x):
+            if self._shard is not None:
+                # device_put accepts numpy directly and slices
+                # host-side — no intermediate hop via the default
+                # device before the mesh reshard.
+                return jax.device_put(x, self._shard)
+            return jnp.asarray(x)
+
+        self._dev = dev
         self._step = make_step_round(
-            cfg, iids=jnp.asarray(iids), slots=jnp.asarray(slots),
-            with_aux=True,
+            cfg, iids=dev(iids), slots=dev(slots), with_aux=True,
         )
 
         self.state = init_state(cfg, start_index, iids=jnp.asarray(iids))
+        if self._shard is not None:
+            self.state = jax.tree.map(dev, self.state)
         # Host mirrors (updated in advance()).
         self.m_term = np.zeros(self.n, np.int64)
         self.m_vote = np.zeros(self.n, np.int64)
@@ -279,15 +304,15 @@ class BatchedRawNode:
             self.applied[row] = rr.applied
         st = self.state
         self.state = st._replace(
-            term=jnp.asarray(term),
-            vote=jnp.asarray(vote),
-            commit=jnp.asarray(commit),
-            last=jnp.asarray(last),
-            snap_index=jnp.asarray(snap_i),
-            snap_term=jnp.asarray(snap_t),
-            log_term=jnp.asarray(ring),
-            next=jnp.repeat(
-                jnp.asarray(last)[:, None] + 1, cfg.num_replicas, axis=1
+            term=self._dev(term),
+            vote=self._dev(vote),
+            commit=self._dev(commit),
+            last=self._dev(last),
+            snap_index=self._dev(snap_i),
+            snap_term=self._dev(snap_t),
+            log_term=self._dev(ring),
+            next=self._dev(
+                np.repeat(last[:, None] + 1, cfg.num_replicas, axis=1)
             ),
         )
         self.m_term = term.astype(np.int64)
@@ -532,9 +557,9 @@ class BatchedRawNode:
             )
         st, outbox, aux = self._step(
             self.state, inbox,
-            jnp.asarray(ticks), jnp.asarray(camp),
-            jnp.asarray(props_n), jnp.asarray(iso),
-            jnp.asarray(transfer), jnp.asarray(read_req),
+            self._dev(ticks), self._dev(camp),
+            self._dev(props_n), self._dev(iso),
+            self._dev(transfer), self._dev(read_req),
         )
         self.state = st
 
@@ -802,12 +827,12 @@ class BatchedRawNode:
                 residual.pop(0)  # drop oldest whole block (loss is safe)
             self._blocks = deque(residual)
         inbox = MsgSlots(
-            valid=jnp.asarray(valid), type=jnp.asarray(typ),
-            term=jnp.asarray(term), log_term=jnp.asarray(log_term),
-            index=jnp.asarray(index), commit=jnp.asarray(commit),
-            reject=jnp.asarray(reject), reject_hint=jnp.asarray(reject_hint),
-            n_ents=jnp.asarray(n_ents), ctx=jnp.asarray(ctx),
-            ent_terms=jnp.asarray(ent_terms),
+            valid=self._dev(valid), type=self._dev(typ),
+            term=self._dev(term), log_term=self._dev(log_term),
+            index=self._dev(index), commit=self._dev(commit),
+            reject=self._dev(reject), reject_hint=self._dev(reject_hint),
+            n_ents=self._dev(n_ents), ctx=self._dev(ctx),
+            ent_terms=self._dev(ent_terms),
         )
         return inbox
 

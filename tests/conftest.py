@@ -41,3 +41,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "e2e: spawns real member/CLI processes (slower)"
     )
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/differential suites"
+    )
