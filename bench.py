@@ -67,7 +67,8 @@ def _ensure_live_backend() -> None:
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
-def _make_engine(groups: int, lanes_minor: bool):
+def _make_engine(groups: int, lanes_minor: bool,
+                 merged_deliver: bool = False):
     import jax.numpy as jnp
 
     from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
@@ -82,6 +83,7 @@ def _make_engine(groups: int, lanes_minor: bool):
         heartbeat_timeout=4,
         auto_compact=True,  # sustained load: ring chases the applied mark
         lanes_minor=lanes_minor,
+        merged_deliver=merged_deliver,
     )
     eng = MultiRaftEngine(cfg)
     eng.campaign([g * cfg.num_replicas for g in range(groups)])
@@ -119,6 +121,15 @@ def main() -> None:
     layout_env = os.environ.get("BENCH_LAYOUT", "")
     if layout_env and layout_env not in ("major", "minor"):
         raise SystemExit(f"BENCH_LAYOUT must be major|minor, got {layout_env!r}")
+    # Deliver-scan shape: the round-5 on-TPU measurement batch showed
+    # the two merged request/response scans 1.044x the six per-kind
+    # scans on TPU v5 lite (BENCH_NOTES r05; CPU prefers six ~2x), so
+    # accelerators take the merged shape unless pinned otherwise.
+    merged_env = os.environ.get("BENCH_MERGED_DELIVER", "")
+    if merged_env and merged_env not in ("0", "1"):
+        raise SystemExit(
+            f"BENCH_MERGED_DELIVER must be 0|1, got {merged_env!r}")
+    merged = (merged_env == "1") if merged_env else accelerated
     cached = None  # (eng, props) reusable for the main run
     if layout_env:
         lanes_minor = layout_env == "minor"
@@ -137,7 +148,7 @@ def main() -> None:
         for lm in (False, True):
             try:
                 t0 = time.perf_counter()
-                engines[lm] = _make_engine(min(groups, 4096), lm)
+                engines[lm] = _make_engine(min(groups, 4096), lm, merged)
                 _note(f"probe layout={'minor' if lm else 'major'} "
                       f"built+compiled in {time.perf_counter()-t0:.1f}s")
                 rates[lm] = _rate(*engines[lm], 8, 2)
@@ -156,13 +167,13 @@ def main() -> None:
     else:
         try:
             t0 = time.perf_counter()
-            eng, props = _make_engine(groups, lanes_minor)
+            eng, props = _make_engine(groups, lanes_minor, merged)
         except Exception as e:  # noqa: BLE001 — one-shot layout fallback
             _note(f"layout={'minor' if lanes_minor else 'major'} failed "
                   f"({e!r}); falling back to the other layout")
             lanes_minor = not lanes_minor
             t0 = time.perf_counter()
-            eng, props = _make_engine(groups, lanes_minor)
+            eng, props = _make_engine(groups, lanes_minor, merged)
         _note(f"main G={groups} built+compiled in {time.perf_counter()-t0:.1f}s")
     rate = _rate(eng, props, 16, 8)
     _note(f"main rate: {rate:.0f} group-rounds/s")
@@ -200,6 +211,7 @@ def main() -> None:
                 "unit": (
                     f"group-rounds/s ({platform}, G={groups}, R=3, "
                     f"layout={'minor' if lanes_minor else 'major'}, "
+                    f"deliver={'merged' if merged else 'six'}, "
                     f"commit_p50={commit_p50_ms:.2f}ms/{rounds}r)"
                 ),
                 "vs_baseline": round(rate / 1e6, 4),

@@ -700,9 +700,19 @@ class TCPRouter:
                 pass
 
     def send_block(self, _from_id: int, blk) -> None:
-        """Ship a SoA block: ONE pre-encoded frame per target member
-        (vs one frame per message on the object path)."""
+        """Ship a SoA block: pre-encoded frames per target member (vs
+        one frame per message on the object path). Each target's block
+        is split into a LIVENESS half (payload-free records:
+        heartbeats/acks/votes, PRIO_LIVE) and a BULK half (MsgApp with
+        entries, PRIO_BULK) — the rafthttp two-channel discipline
+        (ref: server/etcdserver/api/rafthttp/peer.go:337-349): a queue
+        full of append payloads must never starve or drop the liveness
+        traffic, or followers churn leadership under load. Bulk frames
+        exceeding the codec frame cap are chunked (an oversized frame
+        would kill the receiver's stream every round, forever)."""
         import queue as _q
+
+        from .msgblock import MsgBlock
 
         subs = blk.split_by_target()
         queues: Dict[int, "_q.Queue"] = {}
@@ -713,22 +723,42 @@ class TCPRouter:
                 ent = self._ensure_peer_locked(to)
                 if ent is not None:
                     queues[to] = ent[0]
+
+        def enqueue(q2, sub, prio) -> None:
+            body = sub.to_bytes()
+            if len(body) + 8 > self._max_frame and len(sub) > 1:
+                half = len(sub) // 2
+                enqueue(q2, MsgBlock(sub.rec[:half], sub.ents[:half]),
+                        prio)
+                enqueue(q2, MsgBlock(sub.rec[half:], sub.ents[half:]),
+                        prio)
+                return
+            if len(body) + 8 > self._max_frame:
+                return  # single unsendable record: drop (raft retries)
+            frame = struct.pack(
+                "<II", len(body) + 4, self.BLOCK_SENTINEL) + body
+            try:
+                q2.put_nowait((prio, next(self._seq), frame))
+            except _q.Full:  # drop, never block the round loop
+                pass
+
         for to, sub in subs.items():
             q2 = queues.get(to)
             if q2 is None:
                 continue
-            body = sub.to_bytes()
-            frame = struct.pack(
-                "<II", len(body) + 4, self.BLOCK_SENTINEL) + body
-            try:
-                # Blocks (heartbeats/acks/votes) jump the bulk queue:
-                # a queue full of MsgApp resends must never starve the
-                # liveness traffic, or followers churn leadership under
-                # load — the rafthttp two-channel priority
-                # (ref: server/etcdserver/api/rafthttp/peer.go:337-349).
-                q2.put_nowait((self.PRIO_LIVE, next(self._seq), frame))
-            except _q.Full:  # drop, never block the round loop
-                pass
+            has_ents = sub.rec["n_ents"] > 0
+            if has_ents.any():
+                live = MsgBlock(
+                    sub.rec[~has_ents],
+                    [e for e, b in zip(sub.ents, has_ents) if not b])
+                bulk = MsgBlock(
+                    sub.rec[has_ents],
+                    [e for e, b in zip(sub.ents, has_ents) if b])
+                if len(live):
+                    enqueue(q2, live, self.PRIO_LIVE)
+                enqueue(q2, bulk, self.PRIO_BULK)
+            else:
+                enqueue(q2, sub, self.PRIO_LIVE)
 
     def _ensure_peer_locked(self, to: int):
         """Resolve or lazily create the (queue, sender) for a peer.

@@ -1,25 +1,28 @@
-"""SoA message blocks: the hosted fast path for payload-free raft
-traffic.
+"""SoA message blocks: the hosted fast path for raft traffic.
 
-At G=1024 a single heartbeat round emits ~2*G messages per member;
-materializing each as a Python ``Message`` (collect -> encode -> socket
--> decode -> stage) costs ~100us apiece, which is the entire round
-budget — the hosted service rate was gated on it. Payload-free message
-types (heartbeats, acks, votes, empty appends, TimeoutNow) instead stay
-as one packed numpy record array end-to-end: sliced straight out of the
-device outbox, shipped as ONE frame per peer per round, and scattered
-into the next round's inbox with vectorized first-wins merging.
+At G=1024 a single round emits ~2*G messages per member; materializing
+each as a Python ``Message`` (collect -> encode -> socket -> decode ->
+per-message lock + stage) costs ~100us apiece, which is the entire
+round budget — the hosted service rate was gated on it. Messages
+instead stay as one packed numpy record array end-to-end: sliced
+straight out of the device outbox, shipped as ONE frame per peer per
+round, and scattered into the next round's inbox with vectorized
+first-wins merging.
 
-Only MsgApp-with-entries and MsgSnap — the two types that carry bytes
-the device never sees — take the per-message object path. This is the
-batched analog of the reference's two rafthttp channels: the cheap
-high-rate stream for small messages and the pipeline for big ones
-(ref: server/etcdserver/api/rafthttp/peer.go:337-349).
+Since round 5 the block also carries MsgApp WITH entries: each record
+has an ``n_ents`` count and the frame a trailing entries section
+(entry indexes are implicit — MsgApp entries are contiguous from
+``index+1``). Only MsgSnap (app-state payloads attached by the hosting
+layer at send time) takes the per-message object path. This is the
+batched analog of the reference's two rafthttp channels
+(ref: server/etcdserver/api/rafthttp/peer.go:337-349), with the bulk
+append stream vectorized too.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+import struct
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -42,7 +45,7 @@ from .step import (
     T_VOTE_RESP,
 )
 
-# One wire record per message; packed little-endian, 33 bytes.
+# One wire record per message; packed little-endian, 34 bytes.
 REC_DTYPE = np.dtype([
     ("row", "<u4"),          # receiver-side row (group id in hosting)
     ("to", "<u1"),           # target slot + 1 (member id)
@@ -50,6 +53,7 @@ REC_DTYPE = np.dtype([
     ("lane", "<u1"),         # inbox lane (KIND_*)
     ("type", "<u1"),         # wire type (T_*)
     ("reject", "<u1"),
+    ("n_ents", "<u1"),       # entries in the trailing section (T_APP)
     ("term", "<u4"),
     ("log_term", "<u4"),
     ("index", "<u4"),
@@ -57,6 +61,12 @@ REC_DTYPE = np.dtype([
     ("reject_hint", "<u4"),
     ("ctx", "<u4"),          # 4-byte context word
 ])
+
+# Per-entry wire header in the entries section: term, etype, data len.
+_ENT_HDR = struct.Struct("<IBI")
+
+# One entry as carried by a block: (term, etype, data).
+BlockEnt = Tuple[int, int, bytes]
 
 # Wire type -> inbox lane, as a lookup table for vectorized use
 # (mirrors rawnode._LANE).
@@ -73,72 +83,120 @@ for _t, _lane in (
     LANE_OF[_t] = _lane
 
 
-def validate_records(rec: np.ndarray, n_rows: int,
-                     num_replicas: int) -> np.ndarray:
-    """Filter wire-controlled block records down to the well-formed
-    subset; the rest are dropped, matching the object path's
-    corrupt-frame-drop semantics (hosting.py decode).
-
-    A record is well-formed iff row < n_rows, 1 <= frm <= R,
-    lane < NUM_KINDS and lane == LANE_OF[type]. Anything else would
-    index the dense inbox out of range (crashing the member's round
-    loop) or — worse, for frm=0 — wrap to a negative flat index and
-    silently forge a message into a DIFFERENT group's inbox slot.
-    """
-    if len(rec) == 0:
-        return rec
-    typ = rec["type"]
-    # T_SNAP never legitimately rides a block (collect_block keeps it
-    # on the object path, where hosting restores app state and WAL-logs
-    # the snapshot BEFORE the device sees it); a forged one here would
-    # fast-forward raft state past entries whose data never arrived.
-    ok = (
-        (rec["row"] < n_rows)
-        & (rec["frm"] >= 1) & (rec["frm"] <= num_replicas)
-        & (typ < _MAX_T) & (typ != T_SNAP)
-        & (rec["lane"] == LANE_OF[np.minimum(typ, _MAX_T - 1)])
-    )
-    return rec if ok.all() else rec[ok]
-
-
 class MsgBlock:
-    """A batch of payload-free messages as one structured array."""
+    """A batch of messages as one structured array plus, for records
+    with ``n_ents > 0``, their entry payloads (``ents[i]`` is the
+    entry list of ``rec[i]`` or None)."""
 
-    __slots__ = ("rec",)
+    __slots__ = ("rec", "ents")
 
-    def __init__(self, rec: np.ndarray) -> None:
+    def __init__(self, rec: np.ndarray,
+                 ents: Optional[List[Optional[List[BlockEnt]]]] = None
+                 ) -> None:
         self.rec = rec
+        self.ents = ents if ents is not None else [None] * len(rec)
 
     def __len__(self) -> int:
         return len(self.rec)
 
     def to_bytes(self) -> bytes:
-        return self.rec.tobytes()
+        parts = [struct.pack("<I", len(self.rec)), self.rec.tobytes()]
+        for i in np.nonzero(self.rec["n_ents"])[0]:
+            for term, etype, data in self.ents[i]:
+                parts.append(_ENT_HDR.pack(term, etype, len(data)))
+                parts.append(data)
+        return b"".join(parts)
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "MsgBlock":
-        if len(b) % REC_DTYPE.itemsize:
-            raise ValueError(f"block frame not a multiple of "
-                             f"{REC_DTYPE.itemsize}: {len(b)}")
-        return cls(np.frombuffer(b, REC_DTYPE))
+        if len(b) < 4:
+            raise ValueError("block frame too short")
+        (n,) = struct.unpack_from("<I", b)
+        off = 4 + n * REC_DTYPE.itemsize
+        if len(b) < off:
+            raise ValueError(
+                f"block frame truncated: {len(b)} < {off} for {n} recs")
+        rec = np.frombuffer(b, REC_DTYPE, count=n, offset=4)
+        ents: List[Optional[List[BlockEnt]]] = [None] * n
+        for i in np.nonzero(rec["n_ents"])[0]:
+            lst: List[BlockEnt] = []
+            for _ in range(int(rec["n_ents"][i])):
+                if len(b) < off + _ENT_HDR.size:
+                    raise ValueError("entries section truncated")
+                term, etype, ln = _ENT_HDR.unpack_from(b, off)
+                off += _ENT_HDR.size
+                if len(b) < off + ln:
+                    raise ValueError("entry payload truncated")
+                lst.append((term, etype, b[off:off + ln]))
+                off += ln
+            ents[int(i)] = lst
+        if off != len(b):
+            raise ValueError(
+                f"block frame has {len(b) - off} trailing bytes")
+        return cls(rec, ents)
 
     def split_by_target(self) -> Dict[int, "MsgBlock"]:
         """Partition by target member id (slot+1)."""
         rec = self.rec
         out: Dict[int, MsgBlock] = {}
         for to in np.unique(rec["to"]):
-            out[int(to)] = MsgBlock(rec[rec["to"] == to])
+            mask = rec["to"] == to
+            out[int(to)] = MsgBlock(
+                rec[mask],
+                [e for e, keep in zip(self.ents, mask) if keep],
+            )
         return out
+
+
+def validate_block(blk: "MsgBlock", n_rows: int, num_replicas: int,
+                   max_ents: int) -> "MsgBlock":
+    """Filter wire-controlled block records down to the well-formed
+    subset; the rest are dropped, matching the object path's
+    corrupt-frame-drop semantics (hosting.py decode).
+
+    A record is well-formed iff row < n_rows, 1 <= frm <= R,
+    lane == LANE_OF[type], n_ents <= max_ents, entries only on T_APP,
+    and never T_SNAP (snapshots carry app state the hosting layer must
+    restore FIRST; a forged one would fast-forward raft state past
+    entries whose data never arrived). Anything else would index the
+    dense inbox out of range (crashing the member's round loop) or —
+    worse, for frm=0 — wrap to a negative flat index and silently
+    forge a message into a DIFFERENT group's inbox slot.
+    """
+    rec = blk.rec
+    if len(rec) == 0:
+        return blk
+    typ = rec["type"]
+    ok = (
+        (rec["row"] < n_rows)
+        & (rec["frm"] >= 1) & (rec["frm"] <= num_replicas)
+        & (typ < _MAX_T) & (typ != T_SNAP)
+        & (rec["lane"] == LANE_OF[np.minimum(typ, _MAX_T - 1)])
+        & (rec["n_ents"] <= max_ents)
+        & ((rec["n_ents"] == 0) | (typ == T_APP))
+    )
+    # Entries must actually be present for every counted record (a
+    # hand-built block could lie; from_bytes-parsed ones cannot). Only
+    # entry-carrying records need the Python check — the payload-free
+    # majority stays vectorized.
+    for i in np.nonzero(ok & (rec["n_ents"] > 0))[0]:
+        e = blk.ents[i]
+        if e is None or len(e) != int(rec["n_ents"][i]):
+            ok[i] = False
+    if ok.all():
+        return blk
+    return MsgBlock(rec[ok],
+                    [e for e, keep in zip(blk.ents, ok) if keep])
 
 
 def block_messages(blk: "MsgBlock") -> "list":
     """Compat: materialize a block as (row, Message) tuples — for
     low-volume consumers (single-group nodes, trace harnesses) that
     want the object shape."""
-    from ..raft.types import Message, MessageType
+    from ..raft.types import Entry, EntryType, Message, MessageType
 
     out = []
-    for rec in blk.rec:
+    for i, rec in enumerate(blk.rec):
         m = Message(
             type=MessageType(int(rec["type"])),
             to=int(rec["to"]),
@@ -153,24 +211,30 @@ def block_messages(blk: "MsgBlock") -> "list":
         cw = int(rec["ctx"])
         if cw:
             m.context = cw.to_bytes(4, "little")
+        if rec["n_ents"] and blk.ents[i]:
+            m.entries = [
+                Entry(index=int(rec["index"]) + 1 + j, term=term,
+                      data=data, type=EntryType(etype))
+                for j, (term, etype, data) in enumerate(blk.ents[i])
+            ]
         out.append((int(rec["row"]), m))
     return out
 
 
 def collect_block(out_valid: np.ndarray, out: "object",
                   slots: np.ndarray) -> "tuple[MsgBlock, np.ndarray]":
-    """Slice the simple messages out of a device outbox.
+    """Slice the block-eligible messages out of a device outbox.
 
     `out` is the numpy-materialized outbox (fields [n, R, K]); returns
     (block, complex_mask) where complex_mask marks the slots that still
-    need the per-message path (MsgApp with entries, MsgSnap).
+    need the per-message path (MsgSnap only — its app-state payload is
+    attached by the hosting layer at send time). MsgApp entry payloads
+    are NOT attached here (the arena lives in the caller); records
+    carry n_ents and the caller fills ``block.ents`` in record order.
     """
     typ = np.asarray(out.type)
     n_ents = np.asarray(out.n_ents)
-    simple = out_valid & (
-        ((typ != T_APP) & (typ != T_SNAP))
-        | ((typ == T_APP) & (n_ents == 0))
-    )
+    simple = out_valid & (typ != T_SNAP)
     rows, tgt, k = np.nonzero(simple)
     rec = np.empty(len(rows), REC_DTYPE)
     t = typ[rows, tgt, k]
@@ -180,6 +244,7 @@ def collect_block(out_valid: np.ndarray, out: "object",
     rec["lane"] = LANE_OF[t]
     rec["type"] = t
     rec["reject"] = np.asarray(out.reject)[rows, tgt, k]
+    rec["n_ents"] = np.where(t == T_APP, n_ents[rows, tgt, k], 0)
     rec["term"] = np.asarray(out.term)[rows, tgt, k]
     rec["log_term"] = np.asarray(out.log_term)[rows, tgt, k]
     rec["index"] = np.asarray(out.index)[rows, tgt, k]
@@ -190,27 +255,41 @@ def collect_block(out_valid: np.ndarray, out: "object",
 
 
 def merge_blocks(
-    blocks: List[np.ndarray],
+    blocks: List[MsgBlock],
     num_replicas: int,
     num_kinds: int,
     dense: Dict[str, np.ndarray],
-) -> List[np.ndarray]:
+    land_entries=None,
+) -> List[MsgBlock]:
     """Scatter queued block records into the dense inbox arrays.
 
-    `dense` holds the flat-viewable per-field arrays ([n, R, K]); slots
-    already filled (by the legacy per-message path) are respected. Per
-    inbox key (row, sender, lane) at most one record lands per round;
-    FIFO order across blocks is preserved: once a key has a deferred
-    record, later records for that key stay queued behind it. Returns
-    the residual blocks (in order).
+    `dense` holds the flat-viewable per-field arrays ([n, R, K], plus
+    ``ent_terms`` [n, R, K, E]); slots already filled (by the legacy
+    per-message path) are respected. Per inbox key (row, sender, lane)
+    at most one record lands per round; FIFO order across blocks is
+    preserved: once a key has a deferred record, later records for
+    that key stay queued behind it. Returns the residual blocks (in
+    order).
+
+    ``land_entries(row, base_index, ents)`` is invoked for each record
+    with entries that LANDS this round — the caller writes the entry
+    payloads into its arena at that moment (entries of a deferred
+    record stay with it in the residual).
     """
     valid = dense["valid"]
     n_keys = valid.size
     flat_valid = valid.reshape(-1)
     barred = np.zeros(n_keys, bool)
-    residual: List[np.ndarray] = []
-    flat = {f: a.reshape(-1) for f, a in dense.items()}
-    for rec in blocks:
+    residual: List[MsgBlock] = []
+    flat = {f: a.reshape(-1) for f, a in dense.items()
+            if f != "ent_terms"}
+    ent_terms = dense.get("ent_terms")
+    e_cap = ent_terms.shape[-1] if ent_terms is not None else 0
+    flat_ents = (
+        ent_terms.reshape(-1, e_cap) if ent_terms is not None else None
+    )
+    for blk in blocks:
+        rec = blk.rec
         if len(rec) == 0:
             continue
         key = (
@@ -234,8 +313,32 @@ def merge_blocks(
         flat["reject"][idx] = rec["reject"][take].astype(bool)
         flat["reject_hint"][idx] = rec["reject_hint"][take]
         flat["ctx"][idx] = rec["ctx"][take]
+        if "n_ents" in flat:
+            flat["n_ents"][idx] = rec["n_ents"][take]
+        if flat_ents is not None or land_entries is not None:
+            for i in np.nonzero(take & (rec["n_ents"] > 0))[0]:
+                ents = blk.ents[i]
+                if ents is None:
+                    continue
+                if flat_ents is not None:
+                    terms = [t for t, _e, _d in ents[:e_cap]]
+                    flat_ents[key[i], :len(terms)] = terms
+                if land_entries is not None:
+                    land_entries(int(rec["row"][i]),
+                                 int(rec["index"][i]), ents)
         rest = ~take
         if rest.any():
             barred[key[rest]] = True
-            residual.append(rec[rest])
+            residual.append(MsgBlock(
+                rec[rest],
+                [e for e, keep in zip(blk.ents, rest) if keep],
+            ))
     return residual
+
+
+def validate_records(rec: np.ndarray, n_rows: int,
+                     num_replicas: int) -> np.ndarray:
+    """Array-level validation (no entries): kept for callers/tests
+    that stage payload-free records directly. See validate_block."""
+    blk = validate_block(MsgBlock(rec), n_rows, num_replicas, 0)
+    return blk.rec

@@ -52,7 +52,7 @@ from .msgblock import (
     MsgBlock,
     collect_block,
     merge_blocks,
-    validate_records,
+    validate_block,
 )
 from .state import BatchedConfig, BatchedState, LEADER, I32, init_state
 from .step import (
@@ -444,11 +444,12 @@ class BatchedRawNode:
         forged message into another group's inbox slot via negative
         flat-index wraparound. Invalid records are dropped, matching
         the object path's corrupt-frame-drop semantics."""
-        rec = validate_records(blk.rec, self.n, self.cfg.num_replicas)
-        if len(rec) == 0:
+        blk = validate_block(blk, self.n, self.cfg.num_replicas,
+                             self.cfg.max_ents_per_msg)
+        if len(blk) == 0:
             return
         with self._lock:
-            self._blocks.append(rec)
+            self._blocks.append(blk)
 
     def install_snapshot_state(self, row: int, index: int,
                                applied_data_restored: bool = True) -> None:
@@ -816,11 +817,30 @@ class BatchedRawNode:
         for key in dead:
             del self._pending[key]
         if self._blocks:
+            def land_entries(row: int, base: int, ents) -> None:
+                # A block MsgApp's payloads enter the arena the moment
+                # the record lands in the inbox — the block twin of
+                # step()'s arena writes, same never-clobber-committed
+                # rule (committed entries are immutable; only fill
+                # gaps there, post-snapshot resends).
+                ar = self.arena[row]
+                et = self.etypes[row]
+                guard = self._commit_guard[row]
+                for j, (tm, ety, data) in enumerate(ents):
+                    i2 = base + 1 + j
+                    if i2 > guard or i2 not in ar:
+                        ar[i2] = (tm, data)
+                        et.pop(i2, None)
+                        if ety:
+                            et[i2] = ety
+
             residual = merge_blocks(
                 list(self._blocks), r, NUM_KINDS,
                 {"valid": valid, "type": typ, "term": term,
                  "log_term": log_term, "index": index, "commit": commit,
-                 "reject": reject, "reject_hint": reject_hint, "ctx": ctx},
+                 "reject": reject, "reject_hint": reject_hint,
+                 "ctx": ctx, "n_ents": n_ents, "ent_terms": ent_terms},
+                land_entries=land_entries,
             )
             cap = self._RESIDUAL_RECORDS_PER_KEY * self.n * r * NUM_KINDS
             while len(residual) > 1 and sum(map(len, residual)) > cap:
@@ -837,13 +857,32 @@ class BatchedRawNode:
         return inbox
 
     def _collect_messages(self, out, ring64, snap_i, last, term, commit):
-        """outbox slots → one SoA block for the payload-free majority +
-        Message objects for MsgApp-with-entries / MsgSnap (payloads
-        re-attached from the arena)."""
+        """outbox slots → one SoA block for everything except MsgSnap
+        (whose app-state payload the hosting layer attaches at send
+        time). MsgApp entry payloads ride the block's entries section,
+        re-attached from the arena in record order."""
         w = self.cfg.window
         block, complex_mask = collect_block(
             np.asarray(out.valid), out, self.slots
         )
+        # Fill the block's entry payloads from the arena.
+        rec = block.rec
+        for i in np.nonzero(rec["n_ents"])[0]:
+            row = int(rec["row"][i])
+            base = int(rec["index"][i])
+            ar = self.arena[row]
+            ets = self.etypes[row]
+            tgt = int(rec["to"][i]) - 1
+            k = int(rec["lane"][i])
+            ents = []
+            for j in range(int(rec["n_ents"][i])):
+                idx = base + 1 + j
+                et = int(out.ent_terms[row, tgt, k, j])
+                a = ar.get(idx)
+                ok = a is not None and a[0] == et
+                ents.append((et, ets.get(idx, 0) if ok else 0,
+                             a[1] if ok else b""))
+            block.ents[int(i)] = ents
         msgs: List[Tuple[int, Message]] = []
         rows, targets, kinds = np.nonzero(complex_mask)
         for row, tgt, k in zip(rows, targets, kinds):
@@ -864,20 +903,7 @@ class BatchedRawNode:
                 # The device ctx word travels as 4 context bytes
                 # (the reference's Message.Context).
                 m.context = cw.to_bytes(4, "little")
-            ne = int(out.n_ents[row, tgt, k])
-            if t == T_APP and ne:
-                ents = []
-                for j in range(ne):
-                    idx = m.index + 1 + j
-                    et = int(out.ent_terms[row, tgt, k, j])
-                    ar = self.arena[row].get(idx)
-                    ok = ar is not None and ar[0] == et
-                    data = ar[1] if ok else b""
-                    ety = self.etypes[row].get(idx, 0) if ok else 0
-                    ents.append(Entry(index=idx, term=et, data=data,
-                                      type=EntryType(ety)))
-                m.entries = ents
-            elif t == T_SNAP:
+            if t == T_SNAP:
                 # metadata only; the hosting layer attaches app data
                 # (at its applied watermark ≥ this floor) before the
                 # wire (see hosting.py / node.py).

@@ -22,6 +22,7 @@ from etcd_tpu.batched.msgblock import (
     MsgBlock,
     collect_block,
     merge_blocks,
+    validate_block,
     validate_records,
 )
 from etcd_tpu.batched.rawnode import BatchedRawNode
@@ -43,8 +44,10 @@ R = 3
 
 
 def rec_of(row, frm, typ, term=5, index=7, commit=3, reject=0,
-           log_term=2, reject_hint=0, ctx=0, to=1, lane=None):
+           log_term=2, reject_hint=0, ctx=0, to=1, lane=None,
+           n_ents=0):
     r = np.zeros(1, REC_DTYPE)
+    r["n_ents"] = n_ents
     r["row"] = row
     r["to"] = to
     r["frm"] = frm
@@ -90,17 +93,48 @@ class TestWireRoundTrip:
         rec["lane"] = rng.randint(0, NUM_KINDS, n)
         rec["type"] = rng.randint(0, 20, n)
         rec["reject"] = rng.randint(0, 2, n)
+        rec["n_ents"] = 0  # payload-free round-trip (see entries test)
         for f in ("term", "log_term", "index", "commit", "reject_hint",
                   "ctx"):
             rec[f] = rng.randint(0, 1 << 31, n).astype(np.uint32)
         blk = MsgBlock(rec)
         out = MsgBlock.from_bytes(blk.to_bytes())
         assert (out.rec == rec).all()
-        assert len(blk.to_bytes()) == n * REC_DTYPE.itemsize
+        assert len(blk.to_bytes()) == 4 + n * REC_DTYPE.itemsize
 
     def test_from_bytes_rejects_partial_record(self):
+        good = MsgBlock(rec_of(0, 1, T_HB)).to_bytes()
         with pytest.raises(ValueError):
-            MsgBlock.from_bytes(b"x" * (REC_DTYPE.itemsize + 1))
+            MsgBlock.from_bytes(good[:-1])
+        with pytest.raises(ValueError):
+            MsgBlock.from_bytes(good + b"x")
+
+    def test_roundtrip_with_entries(self):
+        rec = recs(
+            rec_of(3, 2, T_APP, index=10, n_ents=2),
+            rec_of(1, 1, T_HB),
+            rec_of(4, 3, T_APP, index=0, n_ents=1),
+        )
+        blk = MsgBlock(rec, [
+            [(5, 0, b"payload-a"), (5, 1, b"")],
+            None,
+            [(6, 0, b"z" * 100)],
+        ])
+        out = MsgBlock.from_bytes(blk.to_bytes())
+        assert (out.rec == rec).all()
+        assert out.ents[0] == [(5, 0, b"payload-a"), (5, 1, b"")]
+        assert out.ents[1] is None
+        assert out.ents[2] == [(6, 0, b"z" * 100)]
+        # split keeps record/entry alignment.
+        by = out.split_by_target()
+        assert by[1].ents[0] == [(5, 0, b"payload-a"), (5, 1, b"")]
+
+    def test_from_bytes_truncated_entries(self):
+        blk = MsgBlock(rec_of(0, 1, T_APP, n_ents=1),
+                       [[(5, 0, b"abcdef")]])
+        b = blk.to_bytes()
+        with pytest.raises(ValueError):
+            MsgBlock.from_bytes(b[:-3])
 
 
 class TestValidate:
@@ -129,11 +163,24 @@ class TestValidate:
         assert len(out) == 0
 
     def test_unmapped_and_oob_type_dropped(self):
-        # T_APP carries entries and must never ride the block path with
-        # a forged lane; type 31 is beyond every mapped type.
+        # Forged lane / out-of-range type.
         bad1 = rec_of(0, 1, T_APP, lane=KIND_HB)
         bad2 = rec_of(0, 1, 31, lane=KIND_HB)
         assert len(validate_records(recs(bad1, bad2), 10, R)) == 0
+
+    def test_entry_count_limits(self):
+        # n_ents beyond the engine cap, entries on a non-APP type, and
+        # a lying count with no payloads are all dropped.
+        b1 = MsgBlock(rec_of(0, 1, T_APP, n_ents=9),
+                      [[(1, 0, b"x")] * 9])
+        assert len(validate_block(b1, 10, R, max_ents=8)) == 0
+        b2 = MsgBlock(rec_of(0, 1, T_HB, n_ents=1), [[(1, 0, b"x")]])
+        assert len(validate_block(b2, 10, R, max_ents=8)) == 0
+        b3 = MsgBlock(rec_of(0, 1, T_APP, n_ents=2), [None])
+        assert len(validate_block(b3, 10, R, max_ents=8)) == 0
+        ok = MsgBlock(rec_of(0, 1, T_APP, n_ents=2),
+                      [[(1, 0, b"x"), (1, 0, b"y")]])
+        assert len(validate_block(ok, 10, R, max_ents=8)) == 1
 
     def test_forged_snap_dropped(self):
         # A T_SNAP record with its own (legal) lane would fast-forward
@@ -153,7 +200,10 @@ class TestValidate:
         garbage["frm"] = [1, 0, 200]
         garbage["lane"] = [KIND_HB, KIND_HB, 5]
         garbage["type"] = [T_HB, T_HB, 255 % 32]
-        rn.step_block(MsgBlock.from_bytes(garbage.tobytes()))
+        import struct as _st
+
+        frame = _st.pack("<I", len(garbage)) + garbage.tobytes()
+        rn.step_block(MsgBlock.from_bytes(frame))
         rn.advance_round()  # must not raise
         rn.advance()
         # Nothing forged: every instance still at term 0, no valid
@@ -166,33 +216,34 @@ class TestMergeBlocks:
         a = rec_of(1, 2, T_HB, term=5)
         b = rec_of(1, 2, T_HB, term=6)  # same key, later record
         dense = make_dense(4)
-        residual = merge_blocks([recs(a, b)], R, NUM_KINDS, dense)
+        residual = merge_blocks([MsgBlock(recs(a, b))], R, NUM_KINDS, dense)
         assert dense["valid"][1, 1, KIND_HB]
         assert dense["term"][1, 1, KIND_HB] == 5
         # The loser stays queued behind the winner (FIFO), not dropped.
-        assert len(residual) == 1 and residual[0]["term"][0] == 6
+        assert len(residual) == 1 and residual[0].rec["term"][0] == 6
 
     def test_barred_key_defers_across_blocks(self):
         # Block 1 defers a record for key K; block 2's record for K must
         # stay behind it even though K's slot is now technically free...
         dense = make_dense(4)
-        blk1 = recs(rec_of(0, 1, T_HB, term=1), rec_of(0, 1, T_HB, term=2))
-        blk2 = rec_of(0, 1, T_HB, term=3)
+        blk1 = MsgBlock(recs(rec_of(0, 1, T_HB, term=1),
+                             rec_of(0, 1, T_HB, term=2)))
+        blk2 = MsgBlock(rec_of(0, 1, T_HB, term=3))
         residual = merge_blocks([blk1, blk2], R, NUM_KINDS, dense)
         assert dense["term"][0, 0, KIND_HB] == 1
-        terms = [int(r["term"][0]) for r in residual]
+        terms = [int(r.rec["term"][0]) for r in residual]
         assert terms == [2, 3]
         # ...and replaying the residuals next round preserves FIFO.
         dense2 = make_dense(4)
         residual2 = merge_blocks(residual, R, NUM_KINDS, dense2)
         assert dense2["term"][0, 0, KIND_HB] == 2
-        assert [int(r["term"][0]) for r in residual2] == [3]
+        assert [int(r.rec["term"][0]) for r in residual2] == [3]
 
     def test_prefilled_slot_defers_record(self):
         dense = make_dense(4)
         dense["valid"][2, 0, KIND_HB] = True  # object path got there
-        residual = merge_blocks([rec_of(2, 1, T_HB, term=9)], R,
-                                NUM_KINDS, dense)
+        residual = merge_blocks([MsgBlock(rec_of(2, 1, T_HB, term=9))],
+                                R, NUM_KINDS, dense)
         assert len(residual) == 1
         assert dense["term"][2, 0, KIND_HB] == 0  # untouched
 
@@ -202,7 +253,7 @@ class TestMergeBlocks:
             rec_of(0, 1, T_HB), rec_of(0, 2, T_HB),
             rec_of(1, 1, T_VOTE), rec_of(3, 3, T_APP_RESP),
         )
-        residual = merge_blocks([blk], R, NUM_KINDS, dense)
+        residual = merge_blocks([MsgBlock(blk)], R, NUM_KINDS, dense)
         assert residual == []
         assert dense["valid"].sum() == 4
 
@@ -210,7 +261,7 @@ class TestMergeBlocks:
         dense = make_dense(2)
         r = rec_of(1, 3, T_APP_RESP, term=11, index=22, commit=33,
                    reject=1, log_term=44, reject_hint=55, ctx=66)
-        merge_blocks([r], R, NUM_KINDS, dense)
+        merge_blocks([MsgBlock(r)], R, NUM_KINDS, dense)
         k = KIND_APP_RESP
         assert dense["type"][1, 2, k] == T_APP_RESP
         assert dense["term"][1, 2, k] == 11
@@ -290,8 +341,9 @@ class TestBlockObjectEquivalence:
 
 class TestCollectBlock:
     def test_collect_splits_simple_from_complex(self):
-        """MsgApp with entries and MsgSnap stay on the object path;
-        everything else (incl. empty MsgApp) rides the block."""
+        """Only MsgSnap stays on the object path; everything else —
+        including MsgApp WITH entries (payloads attached by the caller
+        from its arena) — rides the block."""
         n = 2
 
         class Out:  # minimal outbox stand-in (numpy fields [n, R, K])
@@ -309,17 +361,24 @@ class TestCollectBlock:
 
         valid[0, 1, KIND_HB] = True
         out.type[0, 1, KIND_HB] = T_HB
-        valid[0, 2, KIND_APP] = True  # MsgApp WITH entries -> complex
+        valid[0, 2, KIND_APP] = True  # MsgApp WITH entries -> block too
         out.type[0, 2, KIND_APP] = T_APP
         out.n_ents[0, 2, KIND_APP] = 2
-        valid[1, 0, KIND_APP] = True  # empty MsgApp -> simple
+        valid[1, 0, KIND_APP] = True  # empty MsgApp
         out.type[1, 0, KIND_APP] = T_APP
+        from etcd_tpu.batched.step import T_SNAP
+
+        valid[1, 1, KIND_APP] = True  # MsgSnap -> the only complex path
+        out.type[1, 1, KIND_APP] = T_SNAP
         slots = np.array([0, 1], np.int32)
 
         blk, complex_mask = collect_block(valid, out, slots)
-        assert len(blk) == 2
+        assert len(blk) == 3
         assert set(map(int, blk.rec["type"])) == {T_HB, T_APP}
-        assert complex_mask.sum() == 1 and complex_mask[0, 2, KIND_APP]
+        app_full = blk.rec[(blk.rec["type"] == T_APP)
+                           & (blk.rec["n_ents"] == 2)]
+        assert len(app_full) == 1
+        assert complex_mask.sum() == 1 and complex_mask[1, 1, KIND_APP]
         # Block records carry the sender slot+1 of their ROW.
         frm_of_hb = blk.rec["frm"][blk.rec["type"] == T_HB][0]
         assert frm_of_hb == slots[0] + 1
