@@ -1,0 +1,75 @@
+"""The action-recorder doubles must substitute for their real
+counterparts and capture call sequences (ref: server/mock usage shape
+in etcdserver unit tests)."""
+
+import threading
+
+from etcd_tpu.pkg.mock import (
+    Action,
+    Recorder,
+    StorageRecorder,
+    StoreRecorder,
+    WaitRecorder,
+)
+from etcd_tpu.raft.types import (
+    Entry,
+    HardState,
+    Snapshot,
+    SnapshotMetadata,
+)
+
+
+def test_storage_recorder_records_persist_cycle():
+    s = StorageRecorder()
+    s.save(HardState(term=2, vote=1, commit=3),
+           [Entry(index=4, term=2)], True)
+    s.save_snap(Snapshot(metadata=SnapshotMetadata(index=10, term=2)))
+    s.release(Snapshot(metadata=SnapshotMetadata(index=10, term=2)))
+    s.save_snap(Snapshot())  # empty snapshot: not recorded
+    s.sync()
+    assert [a.name for a in s.actions()] == [
+        "save", "save_snap", "release", "sync"]
+    assert s.actions()[1].params == (10,)
+
+
+def test_wait_recorder_resolves_immediately():
+    w = WaitRecorder()
+    waiter = w.register(7)
+    assert waiter.done() and waiter.wait(timeout=0) is None
+    assert w.trigger(7, "x") is True
+    assert not w.is_registered(7)
+    assert [a.name for a in w.actions()] == ["register", "trigger"]
+    assert w.actions()[0].params == (7,)
+
+
+def test_store_recorder_covers_unknown_surface():
+    st = StoreRecorder()
+    st.set("/a", value="1")
+    st.get("/a")
+    st.delete("/a")
+    st.some_future_method("arg")  # __getattr__ fallback records too
+    assert [a.name for a in st.actions()] == [
+        "set", "get", "delete", "some_future_method"]
+
+
+def test_stream_recorder_times_out_loudly():
+    import pytest
+
+    r = Recorder(stream=True)
+    r.record(Action("only-one"))
+    with pytest.raises(TimeoutError):
+        r.wait(2, timeout=0.05)
+
+
+def test_stream_recorder_blocks_until_count():
+    r = Recorder(stream=True)
+
+    def later():
+        r.record(Action("a"))
+        r.record(Action("b"))
+
+    t = threading.Thread(target=later)
+    t.start()
+    acts = r.wait(2, timeout=5.0)
+    t.join()
+    assert [a.name for a in acts] == ["a", "b"]
