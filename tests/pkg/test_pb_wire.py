@@ -1,0 +1,207 @@
+"""Wire compatibility of the raftpb message layer (etcd_tpu/pb).
+
+The field numbers replicate the reference's raft/raftpb/raft.proto;
+these tests pin (a) golden BYTES hand-derived from the proto wire
+format for messages the reference's gogo marshaler would emit
+(non-nullable fields written unconditionally, ascending field order —
+raft.pb.go MarshalToSizedBuffer), and (b) lossless round-trips of this
+repo's dataclass types through the protobuf layer.
+"""
+
+import pytest
+
+from etcd_tpu.pb import (
+    hardstate_to_pb,
+    message_from_bytes,
+    message_to_bytes,
+    message_to_pb,
+)
+from etcd_tpu.pb import raft_pb2 as pb
+from etcd_tpu.raft.types import (
+    ConfState,
+    Entry,
+    EntryType,
+    HardState,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+)
+
+
+class TestGoldenBytes:
+    def test_hardstate_bytes_match_gogo(self):
+        # Go: MarshalToSizedBuffer writes term(1)=0x08, vote(2)=0x10,
+        # commit(3)=0x18 unconditionally (raft.pb.go:989-1004).
+        assert hardstate_to_pb(
+            HardState(term=2, vote=3, commit=4)
+        ).SerializeToString() == bytes.fromhex("080210031804")
+        # Zeros are STILL emitted (non-nullable), unlike plain proto2.
+        assert hardstate_to_pb(
+            HardState()
+        ).SerializeToString() == bytes.fromhex("080010001800")
+
+    def test_heartbeat_message_bytes(self):
+        # MsgHeartbeat from 1 to 2, term 5, commit 7:
+        # type(1)=08 08, to(2)=10 02, from(3)=18 01, term(4)=20 05,
+        # logTerm(5)=28 00, index(6)=30 00, commit(8)=40 07,
+        # snapshot(9, nested: data absent; metadata(2) with
+        # conf_state(1) empty-but-present + index(2)=0 + term(3)=0),
+        # reject(10)=50 00, rejectHint(11)=58 00.
+        m = Message(type=MessageType.MsgHeartbeat, to=2, from_=1,
+                    term=5, commit=7)
+        got = message_to_bytes(m)
+        # Full golden bytes: scalars, then snapshot(9) whose metadata
+        # carries an (empty-but-present) conf_state with auto_leave
+        # emitted unconditionally (2800), index=0, term=0; then
+        # reject(10)=false, rejectHint(11)=0 — all present, as gogo
+        # emits non-nullable fields even at zero.
+        assert got == bytes.fromhex(
+            "0808" "1002" "1801" "2005" "2800" "3000" "4007"
+            "4a0a" "1208" "0a02" "2800" "1000" "1800"
+            "5000" "5800")
+        assert got.endswith(bytes.fromhex("50005800"))
+        # And the whole thing parses back identically with the
+        # generated (reference-schema) class.
+        p = pb.Message.FromString(got)
+        assert p.type == pb.MsgHeartbeat and p.commit == 7
+
+    def test_entry_field_order_on_wire(self):
+        # Entry declares Type=1, Term=2, Index=3, Data=4: wire order is
+        # ascending field number regardless of declaration order.
+        e = message_to_pb(Message(
+            type=MessageType.MsgApp,
+            entries=[Entry(index=101, term=5, data=b"x")],
+        )).entries[0]
+        assert e.SerializeToString() == bytes.fromhex(
+            "0800"      # Type = EntryNormal(0)
+            "1005"      # Term = 5
+            "1865"      # Index = 101
+            "220178"    # Data = b"x"
+        )
+
+    def test_confchange_id_field_one_on_wire(self):
+        # ConfChange's id is field 1 though declared last; wire order
+        # must lead with it (raft.proto: id=1, type=2, node_id=3).
+        cc = pb.ConfChange(id=9, type=pb.ConfChangeAddNode, node_id=4)
+        assert cc.SerializeToString() == bytes.fromhex(
+            "0809" "1000" "1804")
+
+
+class TestRoundTrip:
+    def test_full_message_round_trip(self):
+        m = Message(
+            type=MessageType.MsgApp, to=3, from_=1, term=7, log_term=6,
+            index=41,
+            entries=[
+                Entry(index=42, term=7, data=b"payload",
+                      type=EntryType.EntryNormal),
+                Entry(index=43, term=7, data=b"cc",
+                      type=EntryType.EntryConfChange),
+            ],
+            commit=40, reject=False, reject_hint=0,
+            context=b"\x01\x02\x03\x04",
+        )
+        got = message_from_bytes(message_to_bytes(m))
+        assert got.type == m.type and got.to == m.to
+        assert got.from_ == m.from_ and got.term == m.term
+        assert got.log_term == m.log_term and got.index == m.index
+        assert got.commit == m.commit and got.context == m.context
+        assert [(e.index, e.term, e.data, e.type) for e in got.entries] \
+            == [(e.index, e.term, e.data, e.type) for e in m.entries]
+
+    def test_snapshot_message_round_trip(self):
+        m = Message(
+            type=MessageType.MsgSnap, to=2, from_=1, term=3,
+            snapshot=Snapshot(
+                data=b"app-state",
+                metadata=SnapshotMetadata(
+                    conf_state=ConfState(voters=[1, 2, 3],
+                                         learners=[4],
+                                         auto_leave=True),
+                    index=100, term=3,
+                ),
+            ),
+        )
+        got = message_from_bytes(message_to_bytes(m))
+        s = got.snapshot
+        assert s.data == b"app-state"
+        assert s.metadata.index == 100 and s.metadata.term == 3
+        assert s.metadata.conf_state.voters == [1, 2, 3]
+        assert s.metadata.conf_state.learners == [4]
+        assert s.metadata.conf_state.auto_leave is True
+
+    def test_reject_roundtrip(self):
+        m = Message(type=MessageType.MsgAppResp, to=1, from_=2, term=4,
+                    index=10, reject=True, reject_hint=8)
+        got = message_from_bytes(message_to_bytes(m))
+        assert got.reject is True or got.reject == True  # noqa: E712
+        assert got.reject_hint == 8
+
+
+class TestConfChangeCrossEncoder:
+    """The repo carries TWO protobuf-wire encoders for conf changes:
+    the hand-rolled types.ConfChange.marshal (omits zero fields — used
+    for log entry payloads) and the pb layer (explicit presence,
+    byte-for-byte gogo). They must decode each other losslessly."""
+
+    def test_handrolled_bytes_parse_with_pb_schema(self):
+        from etcd_tpu.pb import confchange_from_pb
+        from etcd_tpu.raft.types import ConfChange, ConfChangeType
+
+        cc = ConfChange(id=9, type=ConfChangeType.ConfChangeRemoveNode,
+                        node_id=4, context=b"ctx")
+        got = confchange_from_pb(pb.ConfChange.FromString(cc.marshal()))
+        assert (got.id, got.type, got.node_id, got.context) == \
+            (cc.id, cc.type, cc.node_id, cc.context)
+
+    def test_pb_bytes_parse_with_handrolled_decoder(self):
+        from etcd_tpu.pb import confchange_to_pb
+        from etcd_tpu.raft.types import ConfChange, ConfChangeType
+
+        cc = ConfChange(id=0, type=ConfChangeType.ConfChangeAddNode,
+                        node_id=7)
+        got = ConfChange.unmarshal(
+            confchange_to_pb(cc).SerializeToString())
+        assert (got.id, got.type, got.node_id) == (0, cc.type, 7)
+
+    def test_pb_confchange_emits_zero_type_like_gogo(self):
+        from etcd_tpu.pb import confchange_to_pb
+        from etcd_tpu.raft.types import ConfChange, ConfChangeType
+
+        # AddNode (=0) must still be on the wire (gogo emits
+        # non-nullable fields unconditionally); the hand-rolled
+        # encoder omits it — both decode identically.
+        b = confchange_to_pb(ConfChange(
+            id=9, type=ConfChangeType.ConfChangeAddNode,
+            node_id=4)).SerializeToString()
+        assert b == bytes.fromhex("0809" "1000" "1804")
+
+    def test_confchange_v2_cross(self):
+        from etcd_tpu.pb import confchange_v2_from_pb, confchange_v2_to_pb
+        from etcd_tpu.raft.types import (
+            ConfChangeSingle,
+            ConfChangeTransition,
+            ConfChangeType,
+            ConfChangeV2,
+        )
+
+        cc2 = ConfChangeV2(
+            transition=ConfChangeTransition.ConfChangeTransitionJointExplicit,
+            changes=[
+                ConfChangeSingle(ConfChangeType.ConfChangeAddNode, 2),
+                ConfChangeSingle(ConfChangeType.ConfChangeRemoveNode, 3),
+            ],
+            context=b"x",
+        )
+        # hand-rolled bytes -> pb -> dataclass
+        got = confchange_v2_from_pb(
+            pb.ConfChangeV2.FromString(cc2.marshal()))
+        assert got.transition == cc2.transition
+        assert [(c.type, c.node_id) for c in got.changes] == \
+            [(c.type, c.node_id) for c in cc2.changes]
+        # pb bytes -> hand-rolled decoder
+        back = ConfChangeV2.unmarshal(
+            confchange_v2_to_pb(cc2).SerializeToString())
+        assert [(c.type, c.node_id) for c in back.changes] == \
+            [(c.type, c.node_id) for c in cc2.changes]
