@@ -11,11 +11,15 @@ unconditionally, so explicit presence makes our bytes equal
 byte-for-byte to Go's for the same logical message (decoding is
 forgiving in both directions regardless).
 
-This closes the MESSAGE half of ecosystem interop; gRPC transport
-framing remains descoped (README "Wire interop").
+``kv_pb2``/``kv_convert`` do the same for the etcdserverpb KV client
+subset (KeyValue/ResponseHeader/Range/Put/DeleteRange — proto3, where
+zero scalars are omitted by both sides, so no presence discipline is
+needed). This closes the MESSAGE half of ecosystem interop; gRPC
+transport framing remains descoped (README "Wire interop").
 """
 
-from . import raft_pb2  # noqa: F401
+from . import kv_pb2, raft_pb2  # noqa: F401
+from . import kv_convert  # noqa: F401
 from .convert import (  # noqa: F401
     confchange_from_pb,
     confchange_to_pb,

@@ -1,0 +1,161 @@
+"""server.api KV dataclasses <-> etcdserverpb wire messages.
+
+proto3 (like the reference's rpc.proto): zero-valued scalars are
+omitted on the wire by BOTH the reference's gogo marshaler and python
+protobuf, so no explicit-presence discipline is needed here (contrast
+convert.py for the proto2 raftpb layer).
+"""
+
+from __future__ import annotations
+
+from ..server.api import (
+    DeleteRangeRequest,
+    DeleteRangeResponse,
+    KeyValue,
+    PutRequest,
+    PutResponse,
+    RangeRequest,
+    RangeResponse,
+    ResponseHeader,
+    SortOrder,
+    SortTarget,
+)
+from . import kv_pb2 as kpb
+
+
+def kv_to_pb(kv: KeyValue) -> "kpb.KeyValue":
+    return kpb.KeyValue(
+        key=kv.key, create_revision=kv.create_revision,
+        mod_revision=kv.mod_revision, version=kv.version,
+        value=kv.value, lease=kv.lease,
+    )
+
+
+def kv_from_pb(p: "kpb.KeyValue") -> KeyValue:
+    return KeyValue(
+        key=p.key, create_revision=p.create_revision,
+        mod_revision=p.mod_revision, version=p.version,
+        value=p.value, lease=p.lease,
+    )
+
+
+def header_to_pb(h: ResponseHeader) -> "kpb.ResponseHeader":
+    return kpb.ResponseHeader(
+        cluster_id=h.cluster_id, member_id=h.member_id,
+        revision=h.revision, raft_term=h.raft_term,
+    )
+
+
+def header_from_pb(p: "kpb.ResponseHeader") -> ResponseHeader:
+    return ResponseHeader(
+        cluster_id=p.cluster_id, member_id=p.member_id,
+        revision=p.revision, raft_term=p.raft_term,
+    )
+
+
+def put_request_to_pb(r: PutRequest) -> "kpb.PutRequest":
+    return kpb.PutRequest(
+        key=r.key, value=r.value, lease=r.lease, prev_kv=r.prev_kv,
+        ignore_value=r.ignore_value, ignore_lease=r.ignore_lease,
+    )
+
+
+def put_request_from_pb(p: "kpb.PutRequest") -> PutRequest:
+    return PutRequest(
+        key=p.key, value=p.value, lease=p.lease, prev_kv=p.prev_kv,
+        ignore_value=p.ignore_value, ignore_lease=p.ignore_lease,
+    )
+
+
+def put_response_to_pb(r: PutResponse) -> "kpb.PutResponse":
+    out = kpb.PutResponse(header=header_to_pb(r.header))
+    if r.prev_kv is not None:
+        out.prev_kv.CopyFrom(kv_to_pb(r.prev_kv))
+    return out
+
+
+def put_response_from_pb(p: "kpb.PutResponse") -> PutResponse:
+    return PutResponse(
+        header=header_from_pb(p.header),
+        prev_kv=kv_from_pb(p.prev_kv) if p.HasField("prev_kv") else None,
+    )
+
+
+def range_request_to_pb(r: RangeRequest) -> "kpb.RangeRequest":
+    return kpb.RangeRequest(
+        key=r.key, range_end=r.range_end, limit=r.limit,
+        revision=r.revision, sort_order=int(r.sort_order),
+        sort_target=int(r.sort_target), serializable=r.serializable,
+        keys_only=r.keys_only, count_only=r.count_only,
+        min_mod_revision=r.min_mod_revision,
+        max_mod_revision=r.max_mod_revision,
+        min_create_revision=r.min_create_revision,
+        max_create_revision=r.max_create_revision,
+    )
+
+
+def _enum(cls, val, default):
+    """proto3 enums are OPEN: unknown wire values parse fine and must
+    not crash the decode path — fall back to the default (the
+    reference's Go handlers see the raw int and likewise do not
+    reject at decode time)."""
+    try:
+        return cls(val)
+    except ValueError:
+        return default
+
+
+def range_request_from_pb(p: "kpb.RangeRequest") -> RangeRequest:
+    return RangeRequest(
+        key=p.key, range_end=p.range_end, limit=p.limit,
+        revision=p.revision,
+        sort_order=_enum(SortOrder, p.sort_order, SortOrder.NONE),
+        sort_target=_enum(SortTarget, p.sort_target, SortTarget.KEY),
+        serializable=p.serializable, keys_only=p.keys_only,
+        count_only=p.count_only,
+        min_mod_revision=p.min_mod_revision,
+        max_mod_revision=p.max_mod_revision,
+        min_create_revision=p.min_create_revision,
+        max_create_revision=p.max_create_revision,
+    )
+
+
+def range_response_to_pb(r: RangeResponse) -> "kpb.RangeResponse":
+    out = kpb.RangeResponse(
+        header=header_to_pb(r.header), more=r.more, count=r.count)
+    for kv in r.kvs:
+        out.kvs.append(kv_to_pb(kv))
+    return out
+
+
+def range_response_from_pb(p: "kpb.RangeResponse") -> RangeResponse:
+    return RangeResponse(
+        header=header_from_pb(p.header),
+        kvs=[kv_from_pb(kv) for kv in p.kvs],
+        more=p.more, count=p.count,
+    )
+
+
+def delete_request_to_pb(r: DeleteRangeRequest) -> "kpb.DeleteRangeRequest":
+    return kpb.DeleteRangeRequest(
+        key=r.key, range_end=r.range_end, prev_kv=r.prev_kv)
+
+
+def delete_request_from_pb(p: "kpb.DeleteRangeRequest") -> DeleteRangeRequest:
+    return DeleteRangeRequest(
+        key=p.key, range_end=p.range_end, prev_kv=p.prev_kv)
+
+
+def delete_response_to_pb(r: DeleteRangeResponse) -> "kpb.DeleteRangeResponse":
+    out = kpb.DeleteRangeResponse(
+        header=header_to_pb(r.header), deleted=r.deleted)
+    for kv in r.prev_kvs:
+        out.prev_kvs.append(kv_to_pb(kv))
+    return out
+
+
+def delete_response_from_pb(p: "kpb.DeleteRangeResponse") -> DeleteRangeResponse:
+    return DeleteRangeResponse(
+        header=header_from_pb(p.header), deleted=p.deleted,
+        prev_kvs=[kv_from_pb(kv) for kv in p.prev_kvs],
+    )
