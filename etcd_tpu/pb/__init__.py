@@ -12,7 +12,9 @@ byte-for-byte to Go's for the same logical message (decoding is
 forgiving in both directions regardless).
 
 ``kv_pb2``/``kv_convert`` do the same for the etcdserverpb KV client
-subset (KeyValue/ResponseHeader/Range/Put/DeleteRange — proto3, where
+subset (KeyValue/ResponseHeader/Range/Put/DeleteRange plus the Txn
+family: Compare with its target_union oneof, RequestOp/ResponseOp
+unions, nested TxnRequest recursion — proto3, where
 zero scalars are omitted by both sides, so no presence discipline is
 needed). This closes the MESSAGE half of ecosystem interop; gRPC
 transport framing remains descoped (README "Wire interop").

@@ -9,6 +9,9 @@ convert.py for the proto2 raftpb layer).
 from __future__ import annotations
 
 from ..server.api import (
+    Compare,
+    CompareResult,
+    CompareTarget,
     DeleteRangeRequest,
     DeleteRangeResponse,
     KeyValue,
@@ -16,9 +19,13 @@ from ..server.api import (
     PutResponse,
     RangeRequest,
     RangeResponse,
+    RequestOp,
     ResponseHeader,
+    ResponseOp,
     SortOrder,
     SortTarget,
+    TxnRequest,
+    TxnResponse,
 )
 from . import kv_pb2 as kpb
 
@@ -69,7 +76,7 @@ def put_request_from_pb(p: "kpb.PutRequest") -> PutRequest:
 
 def put_response_to_pb(r: PutResponse) -> "kpb.PutResponse":
     out = kpb.PutResponse(header=header_to_pb(r.header))
-    if r.prev_kv is not None:
+    if r.prev_kv is not None:  # oneof-like presence: only set if given
         out.prev_kv.CopyFrom(kv_to_pb(r.prev_kv))
     return out
 
@@ -121,11 +128,9 @@ def range_request_from_pb(p: "kpb.RangeRequest") -> RangeRequest:
 
 
 def range_response_to_pb(r: RangeResponse) -> "kpb.RangeResponse":
-    out = kpb.RangeResponse(
-        header=header_to_pb(r.header), more=r.more, count=r.count)
-    for kv in r.kvs:
-        out.kvs.append(kv_to_pb(kv))
-    return out
+    return kpb.RangeResponse(
+        header=header_to_pb(r.header), more=r.more, count=r.count,
+        kvs=[kv_to_pb(kv) for kv in r.kvs])
 
 
 def range_response_from_pb(p: "kpb.RangeResponse") -> RangeResponse:
@@ -147,15 +152,133 @@ def delete_request_from_pb(p: "kpb.DeleteRangeRequest") -> DeleteRangeRequest:
 
 
 def delete_response_to_pb(r: DeleteRangeResponse) -> "kpb.DeleteRangeResponse":
-    out = kpb.DeleteRangeResponse(
-        header=header_to_pb(r.header), deleted=r.deleted)
-    for kv in r.prev_kvs:
-        out.prev_kvs.append(kv_to_pb(kv))
-    return out
+    return kpb.DeleteRangeResponse(
+        header=header_to_pb(r.header), deleted=r.deleted,
+        prev_kvs=[kv_to_pb(kv) for kv in r.prev_kvs])
 
 
 def delete_response_from_pb(p: "kpb.DeleteRangeResponse") -> DeleteRangeResponse:
     return DeleteRangeResponse(
         header=header_from_pb(p.header), deleted=p.deleted,
         prev_kvs=[kv_from_pb(kv) for kv in p.prev_kvs],
+    )
+
+
+def compare_to_pb(c: Compare) -> "kpb.Compare":
+    out = kpb.Compare(result=int(c.result), target=int(c.target),
+                      key=c.key)
+    if c.range_end:
+        out.range_end = c.range_end
+    # The oneof member matching `target` carries the operand (how the
+    # reference's clientv3 builds Compare, clientv3/compare.go).
+    t = c.target
+    if t == CompareTarget.VERSION:
+        out.version = c.version
+    elif t == CompareTarget.CREATE:
+        out.create_revision = c.create_revision
+    elif t == CompareTarget.MOD:
+        out.mod_revision = c.mod_revision
+    elif t == CompareTarget.VALUE:
+        out.value = c.value
+    elif t == CompareTarget.LEASE:
+        out.lease = c.lease
+    return out
+
+
+def compare_from_pb(p: "kpb.Compare") -> Compare:
+    c = Compare(
+        result=_enum(CompareResult, p.result, CompareResult.EQUAL),
+        target=_enum(CompareTarget, p.target, CompareTarget.VERSION),
+        key=p.key, range_end=p.range_end,
+    )
+    which = p.WhichOneof("target_union")
+    if which is not None:
+        setattr(c, which, getattr(p, which))
+    return c
+
+
+def request_op_to_pb(op: RequestOp) -> "kpb.RequestOp":
+    out = kpb.RequestOp()
+    if op.request_range is not None:
+        out.request_range.CopyFrom(range_request_to_pb(op.request_range))
+    elif op.request_put is not None:
+        out.request_put.CopyFrom(put_request_to_pb(op.request_put))
+    elif op.request_delete_range is not None:
+        out.request_delete_range.CopyFrom(
+            delete_request_to_pb(op.request_delete_range))
+    elif op.request_txn is not None:
+        out.request_txn.CopyFrom(txn_request_to_pb(op.request_txn))
+    return out
+
+
+def request_op_from_pb(p: "kpb.RequestOp") -> RequestOp:
+    which = p.WhichOneof("request")
+    if which == "request_range":
+        return RequestOp(request_range=range_request_from_pb(p.request_range))
+    if which == "request_put":
+        return RequestOp(request_put=put_request_from_pb(p.request_put))
+    if which == "request_delete_range":
+        return RequestOp(request_delete_range=delete_request_from_pb(
+            p.request_delete_range))
+    if which == "request_txn":
+        return RequestOp(request_txn=txn_request_from_pb(p.request_txn))
+    return RequestOp()
+
+
+def response_op_to_pb(op: ResponseOp) -> "kpb.ResponseOp":
+    out = kpb.ResponseOp()
+    if op.response_range is not None:
+        out.response_range.CopyFrom(
+            range_response_to_pb(op.response_range))
+    elif op.response_put is not None:
+        out.response_put.CopyFrom(put_response_to_pb(op.response_put))
+    elif op.response_delete_range is not None:
+        out.response_delete_range.CopyFrom(
+            delete_response_to_pb(op.response_delete_range))
+    elif op.response_txn is not None:
+        out.response_txn.CopyFrom(txn_response_to_pb(op.response_txn))
+    return out
+
+
+def response_op_from_pb(p: "kpb.ResponseOp") -> ResponseOp:
+    which = p.WhichOneof("response")
+    if which == "response_range":
+        return ResponseOp(
+            response_range=range_response_from_pb(p.response_range))
+    if which == "response_put":
+        return ResponseOp(response_put=put_response_from_pb(p.response_put))
+    if which == "response_delete_range":
+        return ResponseOp(response_delete_range=delete_response_from_pb(
+            p.response_delete_range))
+    if which == "response_txn":
+        return ResponseOp(
+            response_txn=txn_response_from_pb(p.response_txn))
+    return ResponseOp()
+
+
+def txn_request_to_pb(r: TxnRequest) -> "kpb.TxnRequest":
+    return kpb.TxnRequest(
+        compare=[compare_to_pb(c) for c in r.compare],
+        success=[request_op_to_pb(op) for op in r.success],
+        failure=[request_op_to_pb(op) for op in r.failure])
+
+
+def txn_request_from_pb(p: "kpb.TxnRequest") -> TxnRequest:
+    return TxnRequest(
+        compare=[compare_from_pb(c) for c in p.compare],
+        success=[request_op_from_pb(op) for op in p.success],
+        failure=[request_op_from_pb(op) for op in p.failure],
+    )
+
+
+def txn_response_to_pb(r: TxnResponse) -> "kpb.TxnResponse":
+    return kpb.TxnResponse(
+        header=header_to_pb(r.header), succeeded=r.succeeded,
+        responses=[response_op_to_pb(op) for op in r.responses])
+
+
+def txn_response_from_pb(p: "kpb.TxnResponse") -> TxnResponse:
+    return TxnResponse(
+        header=header_from_pb(p.header), succeeded=p.succeeded,
+        responses=[response_op_from_pb(op) for op in p.responses],
     )

@@ -152,3 +152,112 @@ class TestRemainingConverters:
             bytes.fromhex("0a0161" "2805"))
         got2 = range_request_from_pb(raw)
         assert got2.key == b"a" and got2.sort_order == SortOrder.NONE
+
+
+class TestTxnWire:
+    def test_txn_round_trip_nested(self):
+        from etcd_tpu.pb.kv_convert import (
+            txn_request_from_pb,
+            txn_request_to_pb,
+            txn_response_from_pb,
+            txn_response_to_pb,
+        )
+        from etcd_tpu.server.api import (
+            Compare,
+            CompareResult,
+            CompareTarget,
+            DeleteRangeRequest,
+            PutResponse,
+            RequestOp,
+            ResponseOp,
+            TxnRequest,
+            TxnResponse,
+        )
+
+        req = TxnRequest(
+            compare=[
+                Compare(result=CompareResult.EQUAL,
+                        target=CompareTarget.VERSION, key=b"k",
+                        version=3),
+                Compare(result=CompareResult.GREATER,
+                        target=CompareTarget.VALUE, key=b"k2",
+                        value=b"x", range_end=b"k9"),
+            ],
+            success=[
+                RequestOp(request_put=PutRequest(key=b"k", value=b"v")),
+                RequestOp(request_txn=TxnRequest(success=[
+                    RequestOp(request_delete_range=DeleteRangeRequest(
+                        key=b"gone"))])),
+            ],
+            failure=[RequestOp(request_range=RangeRequest(key=b"k"))],
+        )
+        got = txn_request_from_pb(kpb.TxnRequest.FromString(
+            txn_request_to_pb(req).SerializeToString()))
+        assert got == req
+
+        resp = TxnResponse(
+            header=ResponseHeader(revision=8), succeeded=True,
+            responses=[ResponseOp(response_put=PutResponse(
+                header=ResponseHeader(revision=8)))],
+        )
+        got2 = txn_response_from_pb(kpb.TxnResponse.FromString(
+            txn_response_to_pb(resp).SerializeToString()))
+        assert got2 == resp
+
+    def test_txn_golden_bytes(self):
+        from etcd_tpu.pb.kv_convert import txn_request_to_pb
+        from etcd_tpu.server.api import (
+            Compare,
+            CompareResult,
+            CompareTarget,
+            RequestOp,
+            TxnRequest,
+        )
+
+        # compare(1): {key(3)="k" version(4)=3}; success(2):
+        # {request_put(2): {key="k" value="v"}} — zero result/target
+        # omitted (proto3), oneof member present.
+        req = TxnRequest(
+            compare=[Compare(result=CompareResult.EQUAL,
+                             target=CompareTarget.VERSION, key=b"k",
+                             version=3)],
+            success=[RequestOp(request_put=PutRequest(key=b"k",
+                                                      value=b"v"))],
+        )
+        assert txn_request_to_pb(req).SerializeToString() == \
+            bytes.fromhex("0a051a016b2003" "120812060a016b120176")
+
+    def test_live_server_txn_over_wire(self, tmp_path):
+        from etcd_tpu.functional import Cluster
+        from etcd_tpu.pb.kv_convert import (
+            txn_request_from_pb,
+            txn_response_to_pb,
+        )
+        from etcd_tpu.server.api import (
+            Compare,
+            CompareResult,
+            CompareTarget,
+        )
+
+        c = Cluster(str(tmp_path), n=1)
+        try:
+            lead = c.wait_leader()
+            lead.put(PutRequest(key=b"t", value=b"1"))
+            # if version(t) == 1: put t=2 else: range t — as wire bytes.
+            wire = kpb.TxnRequest()
+            wire.compare.add(target=kpb.Compare.VERSION, key=b"t",
+                             version=1)
+            wire.success.add().request_put.MergeFrom(
+                kpb.PutRequest(key=b"t", value=b"2"))
+            wire.failure.add().request_range.MergeFrom(
+                kpb.RangeRequest(key=b"t"))
+            req = txn_request_from_pb(
+                kpb.TxnRequest.FromString(wire.SerializeToString()))
+            resp_bytes = txn_response_to_pb(
+                lead.txn(req)).SerializeToString()
+            out = kpb.TxnResponse.FromString(resp_bytes)
+            assert out.succeeded
+            got = lead.range(RangeRequest(key=b"t", serializable=True))
+            assert got.kvs[0].value == b"2"
+        finally:
+            c.close()
