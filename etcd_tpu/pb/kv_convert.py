@@ -12,6 +12,9 @@ from ..server.api import (
     Compare,
     CompareResult,
     CompareTarget,
+    LeaseGrantRequest,
+    LeaseGrantResponse,
+    LeaseRevokeRequest,
     DeleteRangeRequest,
     DeleteRangeResponse,
     KeyValue,
@@ -282,3 +285,73 @@ def txn_response_from_pb(p: "kpb.TxnResponse") -> TxnResponse:
         header=header_from_pb(p.header), succeeded=p.succeeded,
         responses=[response_op_from_pb(op) for op in p.responses],
     )
+
+
+# -- watch / lease -------------------------------------------------------------
+
+def mvcc_kv_to_pb(kv) -> "kpb.KeyValue":
+    # mvcc and server.api KeyValue are field-identical dataclasses, so
+    # kv_to_pb serves both (duck-typed) — one copy site.
+    return kv_to_pb(kv)
+
+
+def mvcc_kv_from_pb(p: "kpb.KeyValue"):
+    from ..storage.mvcc.kv import KeyValue as MvccKV
+
+    k = kv_from_pb(p)
+    return MvccKV(key=k.key, create_revision=k.create_revision,
+                  mod_revision=k.mod_revision, version=k.version,
+                  value=k.value, lease=k.lease)
+
+
+def event_to_pb(ev) -> "kpb.Event":
+    """mvcc.Event -> mvccpb.Event wire message."""
+    out = kpb.Event(type=int(ev.type), kv=mvcc_kv_to_pb(ev.kv))
+    if ev.prev_kv is not None:
+        out.prev_kv.CopyFrom(mvcc_kv_to_pb(ev.prev_kv))
+    return out
+
+
+def event_from_pb(p: "kpb.Event"):
+    from ..storage.mvcc.kv import Event, EventType
+
+    return Event(
+        type=EventType(p.type), kv=mvcc_kv_from_pb(p.kv),
+        prev_kv=(mvcc_kv_from_pb(p.prev_kv)
+                 if p.HasField("prev_kv") else None),
+    )
+
+
+def watch_events_to_pb(header: ResponseHeader, watch_id: int,
+                       events) -> "kpb.WatchResponse":
+    """One watch-stream delivery as an etcdserverpb WatchResponse."""
+    return kpb.WatchResponse(
+        header=header_to_pb(header), watch_id=watch_id,
+        events=[event_to_pb(ev) for ev in events])
+
+
+def lease_grant_request_to_pb(r) -> "kpb.LeaseGrantRequest":
+    return kpb.LeaseGrantRequest(TTL=r.ttl, ID=r.id)
+
+
+def lease_grant_request_from_pb(p: "kpb.LeaseGrantRequest"):
+    return LeaseGrantRequest(ttl=p.TTL, id=p.ID)
+
+
+def lease_grant_response_to_pb(r) -> "kpb.LeaseGrantResponse":
+    return kpb.LeaseGrantResponse(
+        header=header_to_pb(r.header), ID=r.id, TTL=r.ttl,
+        error=r.error)
+
+
+def lease_grant_response_from_pb(p: "kpb.LeaseGrantResponse"):
+    return LeaseGrantResponse(header=header_from_pb(p.header), id=p.ID,
+                              ttl=p.TTL, error=p.error)
+
+
+def lease_revoke_request_to_pb(r) -> "kpb.LeaseRevokeRequest":
+    return kpb.LeaseRevokeRequest(ID=r.id)
+
+
+def lease_revoke_request_from_pb(p: "kpb.LeaseRevokeRequest"):
+    return LeaseRevokeRequest(id=p.ID)
