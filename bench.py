@@ -17,6 +17,19 @@ lane-filling minor layout is pinned by default, overridable with
 BENCH_LAYOUT=major|minor, with a one-shot fallback to the other layout
 if the pinned one fails to build.
 
+Persistent compile cache: every engine build routes XLA compilations
+through the shared on-disk cache (batched/compile_cache.py, env
+ETCD_TPU_COMPILE_CACHE), so the second bench of an identical config
+pays a disk hit instead of the full compile (~500s per G=65536 config
+over the TPU tunnel, BENCH_NOTES r05). Build times are logged per
+config so warm/cold is visible in the stderr trace.
+
+Round pipelining: BENCH_PIPELINE=1 drives the measured loop through
+`run_rounds_pipelined` (double-buffered chunks, donated state; chunk
+k+1 enqueued while chunk k runs) instead of sequential `run_rounds`
+calls — the dispatch-gap experiment knob. Default off: the headline
+number stays methodologically comparable to BENCH_r05.
+
 Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}
 with commit-p50 detail inside "unit".
 """
@@ -69,49 +82,29 @@ def _ensure_live_backend() -> None:
 
 def _make_engine(groups: int, lanes_minor: bool,
                  merged_deliver: bool = False):
-    import jax.numpy as jnp
+    # Canonical config + setup shared with tools/frontier_sweep.py so
+    # the two tools' numbers stay methodologically comparable.
+    from etcd_tpu.tools.benchlib import make_bench_engine
 
-    from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
-
-    cfg = BatchedConfig(
-        num_groups=groups,
-        num_replicas=3,
-        window=32,
-        max_ents_per_msg=4,
-        max_props_per_round=2,
-        election_timeout=1 << 20,  # steady state: no timer elections
-        heartbeat_timeout=4,
-        auto_compact=True,  # sustained load: ring chases the applied mark
-        lanes_minor=lanes_minor,
-        merged_deliver=merged_deliver,
-    )
-    eng = MultiRaftEngine(cfg)
-    eng.campaign([g * cfg.num_replicas for g in range(groups)])
-    eng.run_rounds(4, tick=False)
-    leaders = eng.leaders()
-    assert (leaders == 0).all(), "election failed in bench setup"
-    props = jnp.zeros((cfg.num_instances,), jnp.int32)
-    props = props.at[jnp.arange(groups) * cfg.num_replicas].set(2)
-    return eng, props
+    return make_bench_engine(groups, lanes_minor, merged_deliver)
 
 
-def _rate(eng, props, rounds_per_call: int, calls: int) -> float:
-    import jax
+def _rate(eng, props, rounds_per_call: int, calls: int,
+          pipelined: bool = False) -> float:
+    from etcd_tpu.tools.benchlib import measure_rate
 
-    eng.run_rounds(rounds_per_call, tick=True, propose_n=props)  # warmup
-    jax.block_until_ready(eng.state.commit)
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        eng.run_rounds(rounds_per_call, tick=True, propose_n=props)
-    jax.block_until_ready(eng.state.commit)
-    dt = time.perf_counter() - t0
-    return eng.cfg.num_groups * rounds_per_call * calls / dt
+    return measure_rate(eng, props, rounds_per_call, calls,
+                        pipelined=pipelined)
 
 
 def main() -> None:
     _ensure_live_backend()
     import jax
-    import jax.numpy as jnp
+
+    from etcd_tpu.batched.compile_cache import enable_compile_cache
+
+    cache_dir = enable_compile_cache()
+    _note(f"compile cache: {cache_dir or 'disabled'}")
 
     platform = jax.devices()[0].platform
     # "axon" is the tunneled TPU plugin's platform name.
@@ -130,6 +123,10 @@ def main() -> None:
         raise SystemExit(
             f"BENCH_MERGED_DELIVER must be 0|1, got {merged_env!r}")
     merged = (merged_env == "1") if merged_env else accelerated
+    pipe_env = os.environ.get("BENCH_PIPELINE", "")
+    if pipe_env and pipe_env not in ("0", "1"):
+        raise SystemExit(f"BENCH_PIPELINE must be 0|1, got {pipe_env!r}")
+    pipelined = pipe_env == "1"
     cached = None  # (eng, props) reusable for the main run
     if layout_env:
         lanes_minor = layout_env == "minor"
@@ -175,33 +172,14 @@ def main() -> None:
             t0 = time.perf_counter()
             eng, props = _make_engine(groups, lanes_minor, merged)
         _note(f"main G={groups} built+compiled in {time.perf_counter()-t0:.1f}s")
-    rate = _rate(eng, props, 16, 8)
+    rate = _rate(eng, props, 16, 8, pipelined=pipelined)
     _note(f"main rate: {rate:.0f} group-rounds/s")
     commits = eng.commits()
     assert commits.min() > 0
 
-    # Commit p50: propose one entry per group at a quiet point, then
-    # step single rounds until every group's commit covers it — the
-    # wall-clock from propose to quorum-commit (all groups move in
-    # lockstep, so p50 == the common latency).
-    one = jnp.zeros((eng.cfg.num_instances,), jnp.int32)
-    one = one.at[jnp.arange(groups) * eng.cfg.num_replicas].set(1)
-    # Warm the single-round program (rounds is a static arg) and drain
-    # the in-flight pipeline so the measurement starts quiesced.
-    eng.run_rounds(1, tick=False, propose_n=one)
-    for _ in range(4):
-        eng.run_rounds(1, tick=False)
-    jax.block_until_ready(eng.state.commit)
-    base = eng.commits()[:, 0].min()
-    t0 = time.perf_counter()
-    eng.run_rounds(1, tick=False, propose_n=one)
-    jax.block_until_ready(eng.state.commit)
-    rounds = 1
-    while eng.commits()[:, 0].min() <= base and rounds < 10:
-        eng.run_rounds(1, tick=False)
-        jax.block_until_ready(eng.state.commit)
-        rounds += 1
-    commit_p50_ms = (time.perf_counter() - t0) * 1000
+    from etcd_tpu.tools.benchlib import measure_commit_p50
+
+    commit_p50_ms, rounds = measure_commit_p50(eng)
 
     print(
         json.dumps(
@@ -212,6 +190,7 @@ def main() -> None:
                     f"group-rounds/s ({platform}, G={groups}, R=3, "
                     f"layout={'minor' if lanes_minor else 'major'}, "
                     f"deliver={'merged' if merged else 'six'}, "
+                    f"loop={'pipelined' if pipelined else 'serial'}, "
                     f"commit_p50={commit_p50_ms:.2f}ms/{rounds}r)"
                 ),
                 "vs_baseline": round(rate / 1e6, 4),

@@ -1,0 +1,69 @@
+"""Persistent XLA compilation cache wiring.
+
+The batched round programs are the most expensive artifacts this repo
+builds: a G=65536 closed-loop scan costs ~500s of XLA compile over the
+remote-compile TPU tunnel (BENCH_NOTES r05), and every bench config,
+layout probe, and frontier-sweep point used to pay it again from
+scratch. JAX ships a persistent on-disk compilation cache keyed by the
+(program, backend, flags) fingerprint; pointing every entry point at
+one shared directory makes the second compile of an identical config a
+disk hit instead of a recompile.
+
+Wired through ``MultiRaftEngine``/``BatchedRawNode`` (idempotent,
+env-overridable) and explicitly by ``bench.py``, ``tools/tpu_batch.py``
+and ``tools/frontier_sweep.py`` (which log the dir and warm/cold
+compile times).
+
+Environment:
+
+* ``ETCD_TPU_COMPILE_CACHE=<dir>`` — cache directory (default
+  ``~/.cache/etcd_tpu/xla``).
+* ``ETCD_TPU_COMPILE_CACHE=off`` (or ``0``/``none``) — disable.
+
+Layout: one ``jit_<name>-<fingerprint>-cache`` blob per compiled
+program plus an ``-atime`` sidecar (JAX's own format; safe to delete
+wholesale — the next run recompiles and repopulates).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "etcd_tpu", "xla"
+)
+_configured: Optional[str] = None
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Idempotently point JAX's persistent compilation cache at
+    ``cache_dir`` (explicit arg > already-configured dir >
+    ``ETCD_TPU_COMPILE_CACHE`` env > default; the env ``off`` switch
+    applies only to no-arg calls). Returns the active directory, or
+    None when disabled.
+
+    Every program is cached regardless of size/compile time: the round
+    kernels compile in seconds on CPU and minutes over the TPU tunnel,
+    and both are worth the disk hit (frontier sweeps re-enter identical
+    configs constantly).
+    """
+    global _configured
+    env = os.environ.get("ETCD_TPU_COMPILE_CACHE", "")
+    if cache_dir is None and env.lower() in ("0", "off", "none"):
+        return None
+    # A previously configured dir wins over env/default so the no-arg
+    # calls every engine constructor makes don't silently repoint a
+    # cache someone configured explicitly.
+    cache_dir = cache_dir or _configured or env or _DEFAULT_DIR
+    if _configured == cache_dir:
+        return cache_dir
+
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _configured = cache_dir
+    return cache_dir

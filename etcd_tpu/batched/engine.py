@@ -14,19 +14,25 @@ server/etcdserver/raft.go:158-315).
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .compile_cache import enable_compile_cache
 from .state import BatchedConfig, BatchedState, init_state, LEADER, I32
 from .step import MsgSlots, NUM_KINDS, empty_msgs, make_step_round, route
 
 
 class MultiRaftEngine:
     def __init__(self, cfg: BatchedConfig, start_index: int = 0):
-        self.cfg = cfg
+        self.cfg = cfg.validate()
+        # Round programs are expensive to build (minutes over the
+        # remote-compile tunnel); cache compilations across processes
+        # unless ETCD_TPU_COMPILE_CACHE=off.
+        enable_compile_cache()
         self.state = init_state(cfg, start_index)
         self.inbox = empty_msgs(
             (cfg.num_instances, cfg.num_replicas, NUM_KINDS),
@@ -48,9 +54,17 @@ class MultiRaftEngine:
             (st, inbox), _ = jax.lax.scan(
                 body, (st, inbox), None, length=rounds
             )
-            return st, inbox
+            # The scalar fence is a SEPARATE output buffer: pipelined
+            # callers block on it to bound queue depth without holding
+            # (and thereby breaking) a donated state buffer.
+            return st, inbox, st.commit[0]
 
-        self._closed_loop = jax.jit(closed_loop, static_argnames=("rounds",))
+        # State and inbox are donated: run_rounds/run_rounds_pipelined
+        # reassign both from the return value, so XLA writes round k+1
+        # into round k-1's freed SoA buffers instead of allocating.
+        self._closed_loop = jax.jit(
+            closed_loop, static_argnames=("rounds",), donate_argnums=(0, 1)
+        )
 
     # -- driving --------------------------------------------------------------
 
@@ -85,9 +99,43 @@ class MultiRaftEngine:
         device (one fused lax.scan program)."""
         ticks = jnp.ones_like(self._zeros_b) if tick else self._zeros_b
         props = propose_n if propose_n is not None else self._zeros_i
-        self.state, self.inbox = self._closed_loop(
+        self.state, self.inbox, _ = self._closed_loop(
             self.state, self.inbox, ticks, props, rounds
         )
+
+    def run_rounds_pipelined(self, rounds: int, chunk: int = 16,
+                             depth: int = 2, tick: bool = True,
+                             propose_n: Optional[jnp.ndarray] = None) -> None:
+        """Double-buffered round pipelining: split `rounds` into scan
+        chunks and keep up to `depth` chunks in flight — chunk k+1 is
+        enqueued while chunk k's scan executes, and because the state
+        carry is donated, XLA writes chunk k+1's output into chunk
+        k-1's freed buffers. Dispatch gaps between scans vanish without
+        device memory growing with `rounds`.
+
+        Blocking is on the per-chunk scalar fence (an independent
+        output), never on donated state; the final chunk is left in
+        flight — callers that need completion block on
+        ``self.state.commit`` as usual."""
+        if rounds <= 0:
+            return
+        if chunk <= 0:
+            # A non-positive chunk would dispatch zero-round scans
+            # forever (done never advances) — a silent host hang.
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        ticks = jnp.ones_like(self._zeros_b) if tick else self._zeros_b
+        props = propose_n if propose_n is not None else self._zeros_i
+        fences: deque = deque()
+        done = 0
+        while done < rounds:
+            n = min(chunk, rounds - done)
+            self.state, self.inbox, fence = self._closed_loop(
+                self.state, self.inbox, ticks, props, n
+            )
+            done += n
+            fences.append(fence)
+            while len(fences) > depth:
+                jax.block_until_ready(fences.popleft())
 
     def campaign(self, instance_ids) -> None:
         mask = self._zeros_b.at[jnp.asarray(instance_ids)].set(True)

@@ -27,6 +27,7 @@ real deployments reuses the same ``deliver()`` entry point.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import queue as queue_mod
 import struct
@@ -45,6 +46,8 @@ from .step import T_SNAP
 
 
 from ..pkg.errors import NotLeaderError  # noqa: E402 — shared error type
+
+_log = logging.getLogger("etcd_tpu.batched.hosting")
 
 # WAL record types (the native walog carries opaque frames; these tags
 # make one log serve every group — ref: walpb's entry/state/snapshot
@@ -293,22 +296,39 @@ class MultiRaftMember:
         their messages go out (the reference overlaps the next raft
         Ready with storage/apply the same way — raft.go:218-268 — and
         wal.Save batches; fsync-before-send holds per round because the
-        queue is ordered and the sync covers every appended record)."""
-        while True:
-            rd = self._ready_q.get()
-            if rd is None:
-                return
-            batch = [rd]
+        queue is ordered and the sync covers every appended record).
+
+        Guarded: any exception escaping the body (an OSError from a
+        full/failed disk in _process_readys, a transport fault in the
+        send path) logs and STOPS the member. Without the guard the
+        thread died silently and run_round then blocked forever on the
+        full _ready_q — a wedged member that still answered pings
+        (the reference treats storage errors the same way: a raft
+        storage fault is fatal to the member, never swallowed)."""
+        try:
             while True:
-                try:
-                    nxt = self._ready_q.get_nowait()
-                except queue_mod.Empty:
-                    break
-                if nxt is None:
-                    self._process_readys(batch)
+                rd = self._ready_q.get()
+                if rd is None:
                     return
-                batch.append(nxt)
-            self._process_readys(batch)
+                batch = [rd]
+                while True:
+                    try:
+                        nxt = self._ready_q.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if nxt is None:
+                        self._process_readys(batch)
+                        return
+                    batch.append(nxt)
+                self._process_readys(batch)
+        except Exception:  # noqa: BLE001 — fatal: log + stop the member
+            _log.exception(
+                "member %d: drain worker died; stopping member", self.id)
+            self.stats["drain_dead"] = self.stats.get("drain_dead", 0) + 1
+            # stop() from this thread: joins skip current_thread, and
+            # run_round's queue put is deadline-based, so the round
+            # thread can't be left blocked on a dead drainer.
+            self.stop()
 
     def run_round(self) -> BatchedReady:
         """One device round; the Ready's persist/apply/send runs on the
@@ -322,7 +342,16 @@ class MultiRaftMember:
         self.stats["rounds"] += 1
         self.stats["round_s"] += time.perf_counter() - t0
         if self._drainer is not None:
-            self._ready_q.put(rd)  # bounded: backpressure on the round
+            # Bounded: backpressure on the round — but never block
+            # forever on a stopped/dead drain worker (see _drain_loop's
+            # fatal-fault guard); the unpersisted Ready is dropped with
+            # the member, same as a crash at this point.
+            while not self._stopped.is_set():
+                try:
+                    self._ready_q.put(rd, timeout=0.2)
+                    break
+                except queue_mod.Full:
+                    continue
         else:
             self._process_readys([rd])
         return rd
@@ -550,8 +579,23 @@ class MultiRaftMember:
                 t.join(timeout=5)
         drainer_done = True
         if self._drainer is not None and self._drainer.is_alive():
-            self._ready_q.put(None)  # drain everything queued, then exit
-            if self._drainer is not threading.current_thread():
+            if self._drainer is threading.current_thread():
+                # Fatal-fault stop FROM the drain worker (_drain_loop
+                # guard): it is exiting anyway; a put(None) here could
+                # deadlock on a full queue. Leave the WAL open (the
+                # comment below) — process exit closes it.
+                drainer_done = False
+            else:
+                # Timed put, re-checking liveness: a drainer that hit
+                # its fatal-fault guard is alive-but-exiting and will
+                # never drain a full queue — an untimed put(None) here
+                # would hang shutdown (and the WAL flush after it).
+                while self._drainer.is_alive():
+                    try:
+                        self._ready_q.put(None, timeout=0.2)
+                        break  # drainer drains all queued, then exits
+                    except queue_mod.Full:
+                        continue
                 self._drainer.join(timeout=60)
                 drainer_done = not self._drainer.is_alive()
         with self._lock:

@@ -53,7 +53,10 @@ REC_DTYPE = np.dtype([
     ("lane", "<u1"),         # inbox lane (KIND_*)
     ("type", "<u1"),         # wire type (T_*)
     ("reject", "<u1"),
-    ("n_ents", "<u1"),       # entries in the trailing section (T_APP)
+    ("n_ents", "<u1"),       # entries in the trailing section (T_APP);
+    # one byte caps E at 255 — BatchedConfig.validate() enforces
+    # max_ents_per_msg <= state.MAX_WIRE_ENTS so a config can't wrap it
+
     ("term", "<u4"),
     ("log_term", "<u4"),
     ("index", "<u4"),
@@ -314,7 +317,15 @@ def merge_blocks(
         flat["reject_hint"][idx] = rec["reject_hint"][take]
         flat["ctx"][idx] = rec["ctx"][take]
         if "n_ents" in flat:
-            flat["n_ents"][idx] = rec["n_ents"][take]
+            ne = rec["n_ents"][take]
+            if ent_terms is not None:
+                # The dense inbox carries at most e_cap entry terms per
+                # slot; a record claiming more would land a count its
+                # own ent_terms row can't back (the terms below are
+                # already truncated to e_cap) — clamp so the inbox
+                # stays self-consistent for every caller.
+                ne = np.minimum(ne, e_cap)
+            flat["n_ents"][idx] = ne
         if flat_ents is not None or land_entries is not None:
             for i in np.nonzero(take & (rec["n_ents"] > 0))[0]:
                 ents = blk.ents[i]

@@ -168,7 +168,10 @@ class BatchedRawNode:
         start_index: int = 0,
         mesh: Optional["object"] = None,
     ) -> None:
-        self.cfg = cfg
+        self.cfg = cfg.validate()
+        from .compile_cache import enable_compile_cache
+
+        enable_compile_cache()
         r = cfg.num_replicas
         if groups is None:  # dense all-replica layout
             n = cfg.num_instances
@@ -305,7 +308,10 @@ class BatchedRawNode:
         st = self.state
         self.state = st._replace(
             term=self._dev(term),
-            vote=self._dev(vote),
+            # vote is a narrow (int8) lane under cfg.narrow_lanes; keep
+            # the restored field at the state's storage dtype so the
+            # first round doesn't compile a second program.
+            vote=self._dev(vote).astype(st.vote.dtype),
             commit=self._dev(commit),
             last=self._dev(last),
             snap_index=self._dev(snap_i),

@@ -27,6 +27,13 @@ FOLLOWER, CANDIDATE, LEADER, PRECANDIDATE = 0, 1, 2, 3
 PROBE, REPLICATE, SNAPSHOT = 0, 1, 2
 
 I32 = jnp.int32
+I16 = jnp.int16
+I8 = jnp.int8
+
+# The SoA wire record (msgblock.REC_DTYPE) packs the per-message entry
+# count as one byte; a config exceeding this would silently wrap the
+# count on the wire (E=256 reads back as 0 entries).
+MAX_WIRE_ENTS = 255
 
 
 class BatchedConfig(NamedTuple):
@@ -61,10 +68,38 @@ class BatchedConfig(NamedTuple):
     # small scans ~2x; the merged shape exists for TPU measurement,
     # where per-iteration overhead, not vector width, bounds the round.
     merged_deliver: bool = False
+    # Store the bounded hot lanes (role/vote/lead enums, vote tallies,
+    # progress states, inflight counts) in int8/int16 between rounds:
+    # the round kernel widens them to i32 at entry and narrows at exit,
+    # so the protocol math is bit-identical while the per-round state
+    # carry (HBM traffic on TPU) shrinks. Absolute term/index
+    # watermarks (term, commit, last, match, next, log_term ring) stay
+    # int32 — narrowing those would change wrap semantics.
+    narrow_lanes: bool = False
 
     @property
     def num_instances(self) -> int:
         return self.num_groups * self.num_replicas
+
+    def validate(self) -> "BatchedConfig":
+        """Bounds the wire/state layouts rely on; every engine/rawnode
+        entry point calls this so a bad config fails loudly at build
+        time instead of corrupting silently at runtime."""
+        if not 0 < self.max_ents_per_msg <= MAX_WIRE_ENTS:
+            raise ValueError(
+                f"max_ents_per_msg={self.max_ents_per_msg} out of range: "
+                f"the wire record packs n_ents as one byte "
+                f"(1..{MAX_WIRE_ENTS}); larger appends would wrap the "
+                "entry count on the SoA block path")
+        if not 0 < self.num_replicas <= 127:
+            raise ValueError(
+                f"num_replicas={self.num_replicas} out of range: member "
+                "ids (slot+1) ride one-byte wire fields and int8 lanes")
+        if self.narrow_lanes and self.max_inflight > 32767:
+            raise ValueError(
+                f"max_inflight={self.max_inflight} does not fit the "
+                "int16 inflight lane; lower it or disable narrow_lanes")
+        return self
 
 
 class BatchedState(NamedTuple):
@@ -140,6 +175,35 @@ class BatchedState(NamedTuple):
     send_timeout_now: jnp.ndarray  # [N] bool (target = transferee)
 
 
+# Narrow storage dtype per hot lane (cfg.narrow_lanes). Values are
+# bounded: roles 0..3, member ids 0..R+1 (R <= 127), vote tallies
+# -1..1, progress states 0..2, inflight <= max_inflight (validated
+# <= int16 max). Everything else keeps its wide dtype.
+NARROW_DTYPES = {
+    "role": I8,
+    "vote": I8,
+    "lead": I8,
+    "transferee": I8,
+    "votes": I8,
+    "pr_state": I8,
+    "inflight": I16,
+}
+
+
+def narrow_state(st: BatchedState) -> BatchedState:
+    """Cast the bounded lanes to their narrow storage dtypes."""
+    return st._replace(**{
+        f: getattr(st, f).astype(dt) for f, dt in NARROW_DTYPES.items()
+    })
+
+
+def widen_state(st: BatchedState) -> BatchedState:
+    """Cast narrow storage lanes back to i32 for the round kernel."""
+    return st._replace(**{
+        f: getattr(st, f).astype(I32) for f in NARROW_DTYPES
+    })
+
+
 def _slot_ids(cfg: BatchedConfig) -> np.ndarray:
     return np.arange(cfg.num_instances, dtype=np.int32) % cfg.num_replicas
 
@@ -165,29 +229,34 @@ def init_state(cfg: BatchedConfig, start_index: int = 0,
     else:
         iids = jnp.asarray(iids, I32)
     n = iids.shape[0]
-    zeros_n = jnp.zeros((n,), I32)
-    start = jnp.full((n,), start_index, I32)
+    # Fresh buffers per field (no sharing): a buffer aliased into two
+    # state fields cannot be donated to the round kernel ("attempt to
+    # donate the same buffer twice"), and the round loop donates its
+    # state carry so XLA reuses the SoA buffers between rounds.
+    zeros_n = lambda: jnp.zeros((n,), I32)  # noqa: E731
+    start = lambda: jnp.full((n,), start_index, I32)  # noqa: E731
+    start0 = start()
     st = BatchedState(
-        term=zeros_n,
-        vote=zeros_n,
+        term=zeros_n(),
+        vote=zeros_n(),
         role=jnp.full((n,), FOLLOWER, I32),
-        lead=zeros_n,
+        lead=zeros_n(),
         log_term=jnp.zeros((n, w), I32),
-        snap_index=start,
-        snap_term=jnp.where(start > 0, jnp.ones((n,), I32), zeros_n),
-        last=start,
-        commit=start,
-        applied=start,
-        election_elapsed=zeros_n,
-        heartbeat_elapsed=zeros_n,
+        snap_index=start(),
+        snap_term=jnp.where(start0 > 0, jnp.ones((n,), I32), zeros_n()),
+        last=start(),
+        commit=start(),
+        applied=start(),
+        election_elapsed=zeros_n(),
+        heartbeat_elapsed=zeros_n(),
         # Per-instance randomized [et, 2et) from the start (reset_count
         # 0 of the deterministic hash) — a uniform value would make
         # every boot election a guaranteed split vote.
         randomized_timeout=cfg.election_timeout
         + ((iids + 1) * 7919 % cfg.election_timeout),
-        reset_count=zeros_n,
+        reset_count=zeros_n(),
         match=jnp.zeros((n, r), I32),
-        next=jnp.ones((n, r), I32) * (start[:, None] + 1),
+        next=jnp.ones((n, r), I32) * (start0[:, None] + 1),
         pr_state=jnp.full((n, r), PROBE, I32),
         probe_sent=jnp.zeros((n, r), bool),
         pending_snapshot=jnp.zeros((n, r), I32),
@@ -198,9 +267,9 @@ def init_state(cfg: BatchedConfig, start_index: int = 0,
         voter_out=jnp.zeros((n, r), bool),
         learner=jnp.zeros((n, r), bool),
         in_joint=jnp.zeros((n,), bool),
-        transferee=zeros_n,
+        transferee=zeros_n(),
         transfer_sent=jnp.zeros((n,), bool),
-        read_seq=zeros_n,
+        read_seq=zeros_n(),
         read_index=jnp.full((n,), -1, I32),
         read_acks=jnp.zeros((n, r), bool),
         read_ready=jnp.zeros((n,), bool),
@@ -212,4 +281,6 @@ def init_state(cfg: BatchedConfig, start_index: int = 0,
         vote_req_transfer=jnp.zeros((n,), bool),
         send_timeout_now=jnp.zeros((n,), bool),
     )
+    if cfg.narrow_lanes:
+        st = narrow_state(st)
     return st

@@ -55,6 +55,8 @@ from .state import (
     BatchedConfig,
     BatchedState,
     I32,
+    narrow_state,
+    widen_state,
 )
 
 # Message kinds = inbox slot layout (capacity classes, not semantics: a
@@ -95,18 +97,22 @@ class MsgSlots(NamedTuple):
 
 
 def empty_msgs(shape: Tuple[int, ...], num_ents: int) -> MsgSlots:
-    z = jnp.zeros(shape, I32)
+    # One fresh buffer per field (no aliasing): the round loop donates
+    # its inbox, and a buffer appearing under two leaves of a donated
+    # pytree is a runtime error ("attempt to donate the same buffer
+    # twice"). Inside a trace these are constants either way.
+    z = lambda: jnp.zeros(shape, I32)  # noqa: E731
     return MsgSlots(
         valid=jnp.zeros(shape, bool),
-        type=z,
-        term=z,
-        log_term=z,
-        index=z,
-        commit=z,
+        type=z(),
+        term=z(),
+        log_term=z(),
+        index=z(),
+        commit=z(),
         reject=jnp.zeros(shape, bool),
-        reject_hint=z,
-        n_ents=z,
-        ctx=z,
+        reject_hint=z(),
+        n_ents=z(),
+        ctx=z(),
         ent_terms=jnp.zeros(shape + (num_ents,), I32),
     )
 
@@ -1141,6 +1147,12 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
 
     def step_round(st: BatchedState, inbox: MsgSlots, tick_mask, campaign_mask,
                    propose_n, isolate, transfer_to, read_req, iids, slots):
+        if cfg.narrow_lanes:
+            # Narrow lanes live int8/int16 BETWEEN rounds (the donated
+            # state carry); the protocol math runs on i32 exactly as in
+            # the wide layout, so parity is by construction.
+            st = widen_state(st)
+
         def per_instance(iid, slot, sti, inbox_i, do_tick, do_camp, n_new,
                          iso, tr_to, rd_req):
             # Partitioned instances neither receive nor send this round
@@ -1192,10 +1204,19 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
                 iids, slots, st, inbox, tick_mask, campaign_mask,
                 propose_n, isolate, transfer_to, read_req,
             )
+        if cfg.narrow_lanes:
+            sti = narrow_state(sti)
         if with_aux:
             return sti, out, aux
         return sti, out
 
+    # NOT donated: hosting callers (BatchedRawNode) build the inbox by
+    # zero-copy wrapping host numpy staging buffers (jnp.asarray on CPU
+    # aliases the host memory), and donating an aliased buffer lets XLA
+    # write outputs into memory the host still views — observed as
+    # garbage outbox fields on the hosted restart path. Buffer-donation
+    # round pipelining lives in the engine's closed_loop jit
+    # (engine.py), whose state/inbox are always jax-native buffers.
     return jax.jit(step_round)
 
 

@@ -5,3 +5,8 @@ name, so every layer must raise the same classes)."""
 
 class NotLeaderError(Exception):
     """ref: rpctypes.ErrNotLeader — retry against the leader."""
+
+
+class LearnerNotReadyError(Exception):
+    """ref: rpctypes.ErrGRPCLearnerNotReady — can only promote a
+    learner member which is in sync with the leader."""

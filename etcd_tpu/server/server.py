@@ -111,7 +111,7 @@ class TimeoutError_(Exception):
 
 
 # Shared across layers (client failover matches by class name).
-from ..pkg.errors import NotLeaderError  # noqa: E402
+from ..pkg.errors import LearnerNotReadyError, NotLeaderError  # noqa: E402
 
 
 class TooManyRequestsError(Exception):
@@ -737,7 +737,14 @@ class EtcdServer:
             return
         removed_self = False
         if typ == ConfChangeType.ConfChangeAddNode:
-            if self.cluster.member(nid) is None and not self.cluster.is_removed(nid):
+            existing = self.cluster.member(nid)
+            if existing is not None:
+                # AddNode for a member we already track is a learner
+                # promotion (ref: server.go:1938 promoteMember — the
+                # wire carries promotion as AddNode on an existing id).
+                if existing.is_learner:
+                    self.cluster.promote_member(nid)
+            elif not self.cluster.is_removed(nid):
                 m = Member.unmarshal(ctx) if ctx else Member(id=nid, name=f"m{nid}")
                 try:
                     self.cluster.add_member(m)
@@ -1256,11 +1263,46 @@ class EtcdServer:
         )
         return self._propose_conf_change(cc, timeout)
 
+    # A learner is promotable once its match index covers >= 90% of the
+    # leader's (ref: server.go:1473 readyPercent).
+    _LEARNER_READY_PERCENT = 0.9
+
+    def _is_learner_ready(self, mid: int) -> None:
+        """Catch-up gate for promotion (ref: server.go:1446
+        isLearnerReady): from the leader's progress view, the learner's
+        match index must cover >= readyPercent of the leader's own
+        match. Raises LearnerNotReadyError while the learner is still
+        catching up, NotLeaderError when this member has no progress
+        view (only the leader tracks match indexes)."""
+        st = self.node.status()
+        if not st.progress:
+            if self.is_leader():
+                # Leader on a backend whose status() carries no
+                # per-peer progress view (the batched/tpu node tracks
+                # match on device only): nothing to gate on — allow,
+                # as before the gate existed. Raising NotLeaderError
+                # here would make promotion permanently impossible
+                # (clients fail over member-by-member forever).
+                return
+            # Follower: only the leader tracks match indexes.
+            raise NotLeaderError()
+        learner_match = st.progress[mid].match if mid in st.progress else 0
+        leader_match = st.progress[st.id].match if st.id in st.progress else 0
+        if leader_match == 0 or (
+            float(learner_match)
+            < float(leader_match) * self._LEARNER_READY_PERCENT
+        ):
+            raise LearnerNotReadyError(
+                f"learner {mid:x} match {learner_match} has not caught "
+                f"up to leader match {leader_match} "
+                f"(need >= {self._LEARNER_READY_PERCENT:.0%})")
+
     def promote_member(self, mid: int, timeout: Optional[float] = None):
         """Learner → voter, gated on readiness (server.go:1446 isLearnerReady)."""
         m = self.cluster.member(mid)
         if m is None or not m.is_learner:
             raise ValueError(f"member {mid} is not a learner")
+        self._is_learner_ready(mid)
         cc = ConfChange(
             id=self.idgen.next(),
             type=ConfChangeType.ConfChangeAddNode,

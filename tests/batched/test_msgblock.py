@@ -382,3 +382,64 @@ class TestCollectBlock:
         # Block records carry the sender slot+1 of their ROW.
         frm_of_hb = blk.rec["frm"][blk.rec["type"] == T_HB][0]
         assert frm_of_hb == slots[0] + 1
+
+
+class TestWireCountBounds:
+    """ISSUE 1 satellites: the one-byte n_ents wire field and the
+    e_cap-wide dense inbox must never disagree with what a record
+    claims to carry."""
+
+    def test_config_rejects_ents_beyond_wire_byte(self):
+        """REC_DTYPE packs n_ents as <u1: a config with E > 255 would
+        silently wrap entry counts on the wire (E=256 reads back 0).
+        BatchedConfig.validate() must refuse it at build time."""
+        bad = BatchedConfig(
+            num_groups=1, num_replicas=R, window=512,
+            max_ents_per_msg=256, max_props_per_round=1)
+        with pytest.raises(ValueError, match="max_ents_per_msg"):
+            bad.validate()
+        # Every engine entry point validates — the raw node too.
+        with pytest.raises(ValueError, match="max_ents_per_msg"):
+            BatchedRawNode(bad)
+
+    def test_config_accepts_wire_boundary(self):
+        cfg = BatchedConfig(
+            num_groups=1, num_replicas=R, window=512,
+            max_ents_per_msg=255, max_props_per_round=1)
+        assert cfg.validate() is cfg
+        with pytest.raises(ValueError, match="max_ents_per_msg"):
+            cfg._replace(max_ents_per_msg=0).validate()
+
+    def test_merge_clamps_n_ents_to_dense_capacity(self):
+        """A record claiming more entries than the dense inbox's
+        ent_terms row can hold (e_cap) must land with n_ents clamped to
+        e_cap — the terms are already truncated, so an unclamped count
+        would advertise entries the inbox does not carry."""
+        e_cap = 2
+        n = 4
+        dense = make_dense(n)
+        dense["n_ents"] = np.zeros((n, R, NUM_KINDS), np.int32)
+        dense["ent_terms"] = np.zeros((n, R, NUM_KINDS, e_cap), np.int32)
+        ents = [(9, 0, b"")] * 5  # record claims 5 entries
+        blk = MsgBlock(rec_of(2, 1, T_APP, index=4, n_ents=5), [ents])
+        residual = merge_blocks([blk], R, NUM_KINDS, dense)
+        assert not residual
+        lane = LANE_OF[T_APP]
+        assert dense["valid"][2, 0, lane]
+        assert dense["n_ents"][2, 0, lane] == e_cap
+        assert (dense["ent_terms"][2, 0, lane] == 9).all()
+
+    def test_merge_without_ent_terms_keeps_full_count(self):
+        """Callers that land entries via the arena callback (no dense
+        ent_terms) still see the record's full count."""
+        n = 4
+        dense = make_dense(n)
+        dense["n_ents"] = np.zeros((n, R, NUM_KINDS), np.int32)
+        landed = []
+        ents = [(9, 0, b"x")] * 5
+        blk = MsgBlock(rec_of(2, 1, T_APP, index=4, n_ents=5), [ents])
+        merge_blocks([blk], R, NUM_KINDS, dense,
+                     land_entries=lambda row, base, e: landed.append(
+                         (row, base, len(e))))
+        assert dense["n_ents"][2, 0, LANE_OF[T_APP]] == 5
+        assert landed == [(2, 4, 5)]

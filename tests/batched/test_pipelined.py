@@ -1,0 +1,238 @@
+"""Pipelined round-loop differential tests (ISSUE 1 tentpole).
+
+`run_rounds_pipelined` keeps multiple donated-state scan chunks in
+flight; these tests pin that the overlap is pure scheduling — the
+states it produces are bit-identical to single-round stepping (the
+path the shadow-oracle differential suite verifies field-for-field
+against the reference semantics), over long schedules that include
+live timer elections and membership churn, and directly against the
+shadow oracle itself at chunk granularity.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
+from etcd_tpu.batched.shadow import ShadowCluster
+from etcd_tpu.batched.state import LEADER, BatchedState
+
+from .test_differential import device_log, device_state
+
+R = 3
+
+
+def assert_states_equal(a: MultiRaftEngine, b: MultiRaftEngine,
+                        ctx: str) -> None:
+    for f in BatchedState._fields:
+        av = np.asarray(getattr(a.state, f))
+        bv = np.asarray(getattr(b.state, f))
+        assert av.dtype == bv.dtype, f"{ctx}: dtype mismatch on {f}"
+        assert (av == bv).all(), (
+            f"{ctx}: field {f} diverged "
+            f"({(av != bv).sum()}/{av.size} elements)")
+
+
+def make_engine(groups, *, election_timeout=1 << 20, narrow_lanes=False):
+    cfg = BatchedConfig(
+        num_groups=groups,
+        num_replicas=R,
+        window=32,
+        max_ents_per_msg=4,
+        max_props_per_round=2,
+        election_timeout=election_timeout,
+        heartbeat_timeout=4,
+        auto_compact=True,
+        narrow_lanes=narrow_lanes,
+    )
+    return MultiRaftEngine(cfg)
+
+
+class TestPipelinedVsSingleRound:
+    def test_g512_long_schedule_with_elections_and_churn(self):
+        """>=200 rounds at G=512: the pipelined loop (chunked scans,
+        depth-2 in flight, donated buffers) must equal single-round
+        stepping on EVERY state field — commits, terms, leaders, logs,
+        progress, membership masks — through live timer elections
+        (short randomized timeouts) and mid-run conf churn."""
+        groups = 512
+        a = make_engine(groups, election_timeout=32)  # pipelined
+        b = make_engine(groups, election_timeout=32)  # single-round
+        n = a.cfg.num_instances
+        props = jnp.zeros((n,), jnp.int32)
+        props = props.at[jnp.arange(groups) * R].set(1)
+
+        churn = {
+            1: dict(group=5, voters=(0, 1), learners=(2,)),
+            2: dict(group=5, voters=(0, 1, 2), voters_out=(0, 1),
+                    joint=True),
+            3: dict(group=5, voters=(0, 1, 2)),
+        }
+        rounds_done = 0
+        for seg in range(5):
+            if seg in churn:
+                a.set_membership(**churn[seg])
+                b.set_membership(**churn[seg])
+            a.run_rounds_pipelined(48, chunk=16, depth=2, tick=True,
+                                   propose_n=props)
+            for _ in range(48):
+                b.step_round(tick=True, propose_n=props)
+            rounds_done += 48
+            assert_states_equal(a, b, f"after {rounds_done} rounds")
+        assert rounds_done >= 200
+
+        # The schedule must have been a real one: timer elections fired
+        # and quorum commits advanced across the group space.
+        roles = np.asarray(a.state.role)
+        assert (roles == LEADER).sum() > groups // 2, \
+            "timer elections did not elect most groups"
+        commits = a.commits()
+        assert (commits.max(axis=1) > 0).mean() > 0.5, \
+            "most groups must have committed entries"
+
+    def test_nonpositive_chunk_rejected(self):
+        """chunk <= 0 would spin the host loop forever dispatching
+        zero-round scans; it must fail loudly instead."""
+        import pytest
+
+        eng = make_engine(4)
+        with pytest.raises(ValueError, match="chunk"):
+            eng.run_rounds_pipelined(16, chunk=0)
+        with pytest.raises(ValueError, match="chunk"):
+            eng.run_rounds_pipelined(16, chunk=-3)
+        eng.run_rounds_pipelined(0, chunk=0)  # rounds<=0: no-op first
+
+    def test_partial_tail_chunk_and_depth_variants(self):
+        """rounds not divisible by chunk (a second compiled program for
+        the tail) and depth=1 vs depth=3 all land identical states."""
+        base = make_engine(64)
+        base.campaign([g * R for g in range(64)])
+        base.run_rounds(4, tick=False)
+        props = jnp.zeros((base.cfg.num_instances,), jnp.int32)
+        props = props.at[jnp.arange(64) * R].set(2)
+        for _ in range(37):
+            base.step_round(tick=True, propose_n=props)
+
+        for depth in (1, 3):
+            eng = make_engine(64)
+            eng.campaign([g * R for g in range(64)])
+            eng.run_rounds(4, tick=False)
+            eng.run_rounds_pipelined(37, chunk=8, depth=depth,
+                                     tick=True, propose_n=props)
+            assert_states_equal(base, eng, f"depth={depth} tail chunk")
+
+
+class TestPipelinedVsShadowOracle:
+    def test_shadow_lockstep_at_chunk_granularity(self):
+        """The pipelined loop checked against the reference-semantics
+        oracle itself: >=200 pipelined rounds of heartbeat ticks +
+        steady leader proposals, with an explicit mid-run leadership
+        change (campaign + re-election), states compared at every chunk
+        boundary (the pipelined loop's only host-visible points) and
+        full log content at the end.
+
+        Proposals always target the CURRENT leader: the device drops a
+        proposal staged on a follower while the reference forwards it
+        to the leader — the known envelope difference the differential
+        suite excludes (shadow.py docstring)."""
+        groups, window = 2, 64
+        cfg = BatchedConfig(
+            num_groups=groups,
+            num_replicas=R,
+            window=window,
+            max_ents_per_msg=16,
+            max_props_per_round=4,
+            election_timeout=1 << 20,  # elections are explicit below
+            heartbeat_timeout=1,
+            max_inflight=1 << 20,
+            auto_compact=True,
+        )
+        eng = MultiRaftEngine(cfg)
+        shadows = [
+            ShadowCluster(R, election_timeout=1 << 20, heartbeat_timeout=1,
+                          group=g, deterministic_timeouts=True,
+                          auto_compact_window=window, max_ents=16)
+            for g in range(groups)
+        ]
+        n = cfg.num_instances
+
+        def lockstep_control(campaigns=()):
+            """One host round (campaign/settle) mirrored on the oracle."""
+            camp = np.zeros(n, bool)
+            for g in range(groups):
+                for s in campaigns:
+                    camp[g * R + s] = True
+            eng.step_round(campaign_mask=jnp.asarray(camp))
+            for sh in shadows:
+                sh.round(campaigns=list(campaigns))
+
+        def compare(ctx):
+            got = device_state(eng, cfg)
+            want = [s for sh in shadows for s in sh.snapshot_state()]
+            assert got == want, f"{ctx}: {got} != {want}"
+
+        lockstep_control(campaigns=[0])
+        for _ in range(3):
+            lockstep_control()
+        compare("after election")
+        assert (np.asarray(eng.state.role).reshape(groups, R)[:, 0]
+                == LEADER).all()
+
+        chunk, total = 10, 0
+        leader_slot = 0
+        for seg in range(22):
+            if seg == 11:
+                # Depose slot 0: explicit re-election to slot 1, then
+                # proposals follow the new leader.
+                lockstep_control(campaigns=[1])
+                for _ in range(3):
+                    lockstep_control()
+                compare("after re-election")
+                leader_slot = 1
+                assert (np.asarray(eng.state.role).reshape(groups, R)
+                        [:, 1] == LEADER).all()
+            props = jnp.zeros((n,), jnp.int32)
+            props = props.at[jnp.arange(groups) * R + leader_slot].set(1)
+            eng.run_rounds_pipelined(chunk, chunk=chunk, depth=2,
+                                     tick=True, propose_n=props)
+            for sh in shadows:
+                for _ in range(chunk):
+                    sh.round(tick=True, proposals={leader_slot: 1})
+            total += chunk
+            compare(f"segment {seg} ({total} pipelined rounds)")
+        assert total >= 200
+
+        assert int(np.asarray(eng.state.commit).max()) > 5
+        for inst in range(n):
+            sh = shadows[inst // R]
+            assert device_log(eng, cfg, inst) == sh.log_terms(inst % R)
+
+
+class TestNarrowLanes:
+    def test_narrow_lanes_parity_with_wide(self):
+        """cfg.narrow_lanes stores bounded lanes int8/int16 between
+        rounds; the round math runs widened, so every field must equal
+        the wide layout's (after widening) across elections, churn and
+        the pipelined loop."""
+        wide = make_engine(64, election_timeout=16)
+        narrow = make_engine(64, election_timeout=16, narrow_lanes=True)
+        n = wide.cfg.num_instances
+        props = jnp.zeros((n,), jnp.int32)
+        props = props.at[jnp.arange(64) * R].set(1)
+
+        for seg in range(3):
+            if seg == 1:
+                for e in (wide, narrow):
+                    e.set_membership(3, voters=(0, 1), learners=(2,))
+            wide.run_rounds_pipelined(40, chunk=8, tick=True,
+                                      propose_n=props)
+            narrow.run_rounds_pipelined(40, chunk=8, tick=True,
+                                        propose_n=props)
+            for f in BatchedState._fields:
+                wv = np.asarray(getattr(wide.state, f))
+                nv = np.asarray(getattr(narrow.state, f))
+                assert (wv == nv.astype(wv.dtype)).all(), (
+                    f"narrow lane {f} diverged after segment {seg}")
+        # The narrow layout actually narrows (not a silent no-op).
+        assert np.asarray(narrow.state.role).dtype == np.int8
+        assert np.asarray(narrow.state.inflight).dtype == np.int16
+        assert np.asarray(narrow.state.term).dtype == np.int32  # wide

@@ -417,3 +417,95 @@ class TestRestart:
             for s in servers.values():
                 s.stop()
             net.stop()
+
+
+class TestLearnerPromotion:
+    def test_gate_passes_on_progressless_leader_status(self, tmp_path,
+                                                       monkeypatch):
+        """A leader whose backend status() carries no per-peer progress
+        view (the batched/tpu node tracks match on device only) must
+        not be blocked by the catch-up gate: raising NotLeaderError
+        there would make promotion permanently impossible — clients
+        treat that error as fail-over and loop members forever."""
+        from etcd_tpu.raft.rawnode import Status
+
+        net, servers = make_cluster(tmp_path, 3)
+        try:
+            lead = wait_leader(servers)
+            monkeypatch.setattr(servers[lead].node, "status",
+                                lambda: Status())
+            servers[lead]._is_learner_ready(2)  # no exception: allowed
+        finally:
+            for s in servers.values():
+                s.stop()
+            net.stop()
+
+    def test_promote_gated_on_learner_catchup(self, tmp_path):
+        """ISSUE 1 satellite: promote_member's isLearnerReady gate
+        (server.go:1446) — a learner whose match index has not caught
+        up to >=90% of the leader's is refused; a follower (no progress
+        view) answers NotLeader; after real catch-up the promotion
+        lands and the member becomes a voter everywhere."""
+        from etcd_tpu.pkg.errors import LearnerNotReadyError, NotLeaderError
+
+        net, servers = make_cluster(tmp_path, 3)
+        try:
+            lead = wait_leader(servers)
+            for i in range(4):
+                servers[lead].put(PutRequest(key=b"pk%d" % i, value=b"x"))
+            servers[lead].add_member(
+                Member(id=4, name="m4", is_learner=True))
+            wait_until(
+                lambda: all(4 in s.cluster.member_ids()
+                            for s in servers.values()),
+                msg="learner add replicated",
+            )
+            # The learner process hasn't booted: match 0, not ready.
+            with pytest.raises(LearnerNotReadyError):
+                servers[lead].promote_member(4)
+            # Followers have no progress view — only the leader decides.
+            follower = next(i for i in servers if i != lead)
+            with pytest.raises(NotLeaderError):
+                servers[follower].promote_member(4)
+            # Still a learner everywhere (no conf change escaped).
+            assert servers[lead].cluster.member(4).is_learner
+
+            s4 = EtcdServer(
+                ServerConfig(
+                    member_id=4,
+                    peers=[1, 2, 3, 4],
+                    data_dir=str(tmp_path),
+                    network=net,
+                    join=True,
+                    tick_interval=0.01,
+                    request_timeout=10.0,
+                )
+            )
+            servers[4] = s4
+            servers[lead].put(PutRequest(key=b"pm", value=b"vv"))
+            wait_until(
+                lambda: s4.range(
+                    RangeRequest(key=b"pm", serializable=True)
+                ).kvs,
+                timeout=20.0,
+                msg="learner catch-up",
+            )
+
+            def promoted():
+                try:
+                    servers[lead].promote_member(4)
+                    return True
+                except LearnerNotReadyError:
+                    return False
+
+            wait_until(promoted, timeout=20.0,
+                       msg="promotion after catch-up")
+            wait_until(
+                lambda: all(not s.cluster.member(4).is_learner
+                            for s in servers.values()),
+                msg="voter status replicated",
+            )
+        finally:
+            for s in servers.values():
+                s.stop()
+            net.stop()
