@@ -24,6 +24,10 @@ program:
                device engine (the ``raft-backend=tpu`` construction path).
 - ``hosting``: MultiRaftMember/MultiRaftCluster — G groups × R members
                served end-to-end (native WAL, per-group KV apply).
+- ``faults``:  seeded chaos plane for the hosting path — per-link
+               drop/dup/delay/reorder/partition, storage-failpoint
+               crashes, torn-tail WAL injection, kill/restart cycles
+               (the functional tester's fault matrix, batched).
 """
 
 from .state import BatchedConfig, BatchedState, init_state  # noqa: F401
@@ -32,3 +36,10 @@ from .engine import MultiRaftEngine  # noqa: F401
 from .rawnode import BatchedRawNode, BatchedReady, RowRestore  # noqa: F401
 from .node import BatchedNode  # noqa: F401
 from .hosting import MultiRaftCluster, MultiRaftMember  # noqa: F401
+from .faults import (  # noqa: F401
+    ChaosHarness,
+    FaultPlan,
+    FaultSpec,
+    FaultyFabric,
+    LeaderObserver,
+)

@@ -61,6 +61,15 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
 
     import jax
 
+    if cache_dir == _DEFAULT_DIR and _configured is None:
+        # Latch a cache dir the EMBEDDING process already configured
+        # (jax.config / JAX_COMPILATION_CACHE_DIR) instead of silently
+        # repointing the process-wide cache at our default — an app
+        # hosting this engine keeps its own cache.
+        ext = getattr(jax.config, "jax_compilation_cache_dir", None)
+        if ext:
+            _configured = ext
+            return ext
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)

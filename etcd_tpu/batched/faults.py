@@ -1,0 +1,574 @@
+"""Seeded fault injection for the batched multi-raft hosting path.
+
+The reference ships a dedicated functional tester (tests/functional/
+tester: kill/blackhole/delay cases, KV-hash checkers) for the single
+server; this module is its analog for the layer the paper actually bets
+on — ``MultiRaftMember`` over ``InProcRouter`` or the TCP fabric,
+thousands of groups per member. Three planes:
+
+* **message faults** — ``FaultPlan`` (one seed → per-link ``random``
+  streams) decides drop / duplicate / delay / reorder per (src, dst)
+  link; ``FaultyFabric`` interposes on each member's outbound send
+  callables, so the SAME fault plane drives both the in-proc router and
+  real TCP sockets. Symmetric and asymmetric partitions are directed
+  link blocks on the plan.
+* **storage faults** — the gofail-style failpoints hosting.py exposes on
+  its persistence path (``hosting.m<id>.raftBeforeSave`` /
+  ``raftAfterSave``, ref: etcdserver/raft.go raftBeforeSave &c) armed to
+  ``MultiRaftMember.crash()``, plus torn-tail injection (truncate the
+  last WAL segment at an arbitrary byte inside the written prefix).
+* **process faults** — scripted kill/restart cycles: ``crash()`` then a
+  fresh member on the same data_dir, booting through ``_replay``.
+
+Determinism: one seed fixes every fault *decision* (which sends drop,
+how long delays run, where the torn byte lands). Thread scheduling still
+varies wall-clock interleavings run to run — the invariants the
+checkers assert (``etcd_tpu.functional.checker``) hold for every
+interleaving, which is exactly what makes them invariants.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import os
+import random
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..pkg import failpoint
+from ..pkg.failpoint import FailpointPanic
+from .hosting import (
+    GroupKV,
+    InProcRouter,
+    MultiRaftMember,
+    TCPRouter,
+    wait_group_leaders,
+)
+from .state import BatchedConfig, LEADER
+
+_log = logging.getLogger("etcd_tpu.batched.faults")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Per-link fault probabilities (drawn per message batch)."""
+
+    drop: float = 0.0  # lose the batch
+    dup: float = 0.0  # deliver it twice
+    delay: float = 0.0  # hold it for uniform(1ms, delay_max_s)
+    delay_max_s: float = 0.03
+    # Brief hold (0.5–5 ms) WITHOUT the big delay: later sends on the
+    # link overtake this one — cheap, frequent local reordering.
+    reorder: float = 0.0
+
+
+class FaultPlan:
+    """Deterministic fault decisions: one seed → an independent
+    ``random.Random`` stream per directed link, so the decision sequence
+    on a link depends only on (seed, src, dst, #sends on that link),
+    never on cross-thread interleaving. Partitions are a mutable set of
+    blocked directed links layered on top."""
+
+    def __init__(self, seed: int, spec: Optional[FaultSpec] = None) -> None:
+        self.seed = seed
+        self.spec = spec or FaultSpec()
+        self._rngs: Dict[Tuple[int, int], random.Random] = {}
+        self._lock = threading.Lock()
+        self._blocked: set = set()  # directed (src, dst) links
+
+    def link_rng(self, src: int, dst: int) -> random.Random:
+        with self._lock:
+            r = self._rngs.get((src, dst))
+            if r is None:
+                r = random.Random(f"{self.seed}/{src}->{dst}")
+                self._rngs[(src, dst)] = r
+            return r
+
+    def derived_rng(self, tag: str) -> random.Random:
+        """Seed-scoped stream for non-link decisions (torn-byte offset,
+        victim choice, partition schedule)."""
+        return random.Random(f"{self.seed}/{tag}")
+
+    # -- partitions ------------------------------------------------------------
+
+    def block_link(self, src: int, dst: int) -> None:
+        with self._lock:
+            self._blocked.add((src, dst))
+
+    def partition(self, a: int, b: int, symmetric: bool = True) -> None:
+        """Cut a<->b (or only a->b when symmetric=False — the asymmetric
+        half-open link that message-reorder bugs love)."""
+        self.block_link(a, b)
+        if symmetric:
+            self.block_link(b, a)
+
+    def isolate_member(self, mid: int, peers) -> None:
+        for p in peers:
+            if p != mid:
+                self.partition(mid, p, symmetric=True)
+
+    def heal_link(self, src: int, dst: int) -> None:
+        with self._lock:
+            self._blocked.discard((src, dst))
+
+    def heal_all(self) -> None:
+        with self._lock:
+            self._blocked.clear()
+
+    def blocked(self, src: int, dst: int) -> bool:
+        return (src, dst) in self._blocked
+
+    def quiesce(self) -> None:
+        """Episode end: zero the probabilistic faults and heal every
+        partition so the cluster can converge for the checkers."""
+        self.spec = FaultSpec()
+        self.heal_all()
+
+    # -- per-send decision -----------------------------------------------------
+
+    def decide(self, src: int, dst: int) -> Tuple[bool, int, float]:
+        """(drop, copies, delay_s) for the next batch on src->dst."""
+        sp = self.spec
+        r = self.link_rng(src, dst)
+        drop = r.random() < sp.drop
+        copies = 2 if r.random() < sp.dup else 1
+        delay = 0.0
+        if r.random() < sp.delay:
+            delay = r.uniform(0.001, sp.delay_max_s)
+        elif r.random() < sp.reorder:
+            delay = r.uniform(0.0005, 0.005)
+        return drop, copies, delay
+
+
+class FaultyFabric:
+    """Interposes the fault plane on member outbound sends. Works over
+    BOTH routers because each programs ``member._send``/``_send_block``:
+    the wrapper splits every outbound batch by destination, consults the
+    plan per link, and forwards the surviving (possibly delayed or
+    duplicated) sub-batches to the original callables. Delayed
+    deliveries run on one pump thread ordered by due time."""
+
+    def __init__(self, plan: FaultPlan) -> None:
+        self.plan = plan
+        self._stats: Dict[str, int] = defaultdict(int)
+        self._seq = itertools.count()
+        self._cv = threading.Condition()
+        self._heap: List[Tuple[float, int, Callable[[], None]]] = []
+        self._stopped = False
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+        self._pump.start()
+
+    def stats(self) -> Dict[str, int]:
+        with self._cv:
+            return dict(self._stats)
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._cv:
+            self._stats[key] += n
+
+    def wrap(self, member: MultiRaftMember) -> None:
+        """Interpose on `member`'s send callables (call AFTER the router
+        attached them; call again after a restart re-attaches)."""
+        inner = member._send
+        inner_blk = member._send_block
+        src = member.id
+
+        def send(from_id: int, batch) -> None:
+            by_dst: Dict[int, list] = defaultdict(list)
+            for g, m in batch:
+                by_dst[m.to].append((g, m))
+            for dst, sub in by_dst.items():
+                self._ship(src, dst,
+                           lambda s=sub: inner(from_id, s), len(sub))
+
+        member._send = send
+        if inner_blk is not None:
+            def send_block(from_id: int, blk) -> None:
+                for dst, sub in blk.split_by_target().items():
+                    self._ship(src, dst,
+                               lambda s=sub: inner_blk(from_id, s),
+                               len(sub))
+
+            member._send_block = send_block
+
+    def _ship(self, src: int, dst: int, deliver: Callable[[], None],
+              n: int) -> None:
+        if self.plan.blocked(src, dst):
+            self._count("partitioned", n)
+            return
+        drop, copies, delay = self.plan.decide(src, dst)
+        if drop:
+            self._count("dropped", n)
+            return
+        if copies > 1:
+            self._count("duplicated", n)
+            # The duplicate trails slightly — same-instant duplicates
+            # would coalesce in the per-(row,sender,lane) inbox anyway.
+            self._later(delay + 0.002, deliver)
+        if delay > 0:
+            self._count("delayed", n)
+            self._later(delay, deliver)
+        else:
+            self._run(deliver)
+
+    def _run(self, deliver: Callable[[], None]) -> None:
+        try:
+            deliver()
+        except Exception:  # noqa: BLE001 — target died mid-delivery
+            self._count("deliver_error")
+
+    def _later(self, delay: float, deliver: Callable[[], None]) -> None:
+        with self._cv:
+            if self._stopped:
+                return
+            heapq.heappush(
+                self._heap,
+                (time.monotonic() + delay, next(self._seq), deliver))
+            self._cv.notify()
+
+    def _pump_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopped and (
+                    not self._heap
+                    or self._heap[0][0] > time.monotonic()
+                ):
+                    if self._heap:
+                        self._cv.wait(
+                            max(0.0, self._heap[0][0] - time.monotonic()))
+                    else:
+                        self._cv.wait()
+                if self._stopped:
+                    return
+                _due, _seq, deliver = heapq.heappop(self._heap)
+            self._run(deliver)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._heap.clear()
+            self._cv.notify_all()
+        self._pump.join(timeout=5)
+
+
+class LeaderObserver(threading.Thread):
+    """Samples every member's atomic (term, role, lead) view and records
+    which member claimed leadership of each (group, term). Any (group,
+    term) claimed by two different members is an election-safety
+    violation — the at-most-one-leader-per-term checker input (ref:
+    functional tester's leader checks; Jepsen's leader analyses)."""
+
+    def __init__(self, members_fn: Callable[[], List[MultiRaftMember]],
+                 interval: float = 0.005) -> None:
+        super().__init__(daemon=True)
+        self.members_fn = members_fn
+        self.interval = interval
+        self.claims: Dict[Tuple[int, int], int] = {}
+        self.conflicts: List[Tuple[int, int, int, int]] = []
+        # NB: not `_stop` — threading.Thread defines a private _stop()
+        # method that join() calls on interpreter edge paths.
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            for m in self.members_fn():
+                term, role, _lead = m.rn.m_view
+                for g in np.nonzero(role == LEADER)[0]:
+                    key = (int(g), int(term[g]))
+                    prev = self.claims.setdefault(key, m.id)
+                    if prev != m.id:
+                        self.conflicts.append((*key, prev, m.id))
+            self._halt.wait(self.interval)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=5)
+
+
+class ChaosHarness:
+    """R members × G groups with a seeded fault plane, over either the
+    in-proc router (``transport='inproc'``) or real TCP sockets
+    (``transport='tcp'``); supports scripted crash/restart cycles
+    (through ``_replay``), storage-failpoint crashes, torn-tail WAL
+    injection, and an acked-write ledger for the committed-never-lost
+    checker."""
+
+    def __init__(self, data_dir: str, seed: int,
+                 spec: Optional[FaultSpec] = None,
+                 num_members: int = 3, num_groups: int = 8,
+                 cfg: Optional[BatchedConfig] = None,
+                 transport: str = "inproc",
+                 tick_interval: float = 0.02,
+                 pipeline: bool = True) -> None:
+        assert transport in ("inproc", "tcp"), transport
+        self.data_dir = data_dir
+        self.seed = seed
+        self.r = num_members
+        self.g = num_groups
+        self.cfg = cfg or BatchedConfig(
+            num_groups=num_groups, num_replicas=num_members,
+            window=16, max_ents_per_msg=4, max_props_per_round=4,
+            election_timeout=10, heartbeat_timeout=1,
+            pre_vote=True, check_quorum=True, auto_compact=True,
+        )
+        self.transport = transport
+        self.tick_interval = tick_interval
+        self.pipeline = pipeline
+        self.plan = FaultPlan(seed, spec)
+        self.fabric = FaultyFabric(self.plan)
+        self.members: Dict[int, MultiRaftMember] = {}
+        self.routers: Dict[int, TCPRouter] = {}
+        self._ports: Dict[int, int] = {}  # stable rebind port per member
+        self.inproc: Optional[InProcRouter] = (
+            InProcRouter() if transport == "inproc" else None
+        )
+        # (group, key) -> latest value the workload saw applied at its
+        # proposer — committed by definition, so never losable — plus
+        # the full acked-version history per key, so the checker can
+        # tell a lagging member (holds an older acked version) from a
+        # divergent one (holds a value never acked).
+        self.acked: Dict[Tuple[int, bytes], bytes] = {}
+        self.acked_history: Dict[Tuple[int, bytes], List[bytes]] = {}
+        for mid in range(1, num_members + 1):
+            self._boot(mid)
+        for m in self.members.values():
+            m.start()
+
+    # -- membership ------------------------------------------------------------
+
+    def _boot(self, mid: int) -> MultiRaftMember:
+        m = MultiRaftMember(
+            mid, self.r, self.g, self.data_dir, cfg=self.cfg,
+            tick_interval=self.tick_interval, pipeline=self.pipeline,
+        )
+        if self.inproc is not None:
+            self.inproc.attach(m)
+        else:
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    router = TCPRouter(
+                        m, bind=("127.0.0.1", self._ports.get(mid, 0)))
+                    break
+                except OSError:
+                    # Restart must rebind the crashed member's port
+                    # (peer sender lanes captured its addr at thread
+                    # start), but a peer's redial can momentarily squat
+                    # the freed port as its EPHEMERAL source port —
+                    # outbound sockets lack SO_REUSEADDR, which blocks
+                    # the bind; the refused dial frees it right away.
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+            self._ports[mid] = router.addr[1]
+            for other, r2 in self.routers.items():
+                router.add_peer(other, r2.addr)
+                r2.add_peer(mid, router.addr)
+            self.routers[mid] = router
+        self.fabric.wrap(m)
+        self.members[mid] = m
+        return m
+
+    def alive(self) -> List[MultiRaftMember]:
+        return [m for m in self.members.values()
+                if not m._stopped.is_set()]
+
+    # -- process faults --------------------------------------------------------
+
+    def crash(self, mid: int) -> None:
+        """Simulated kill -9 (see MultiRaftMember.crash)."""
+        self.members[mid].crash()
+        router = self.routers.pop(mid, None)
+        if router is not None:
+            router.stop()
+
+    def crash_on_failpoint(self, mid: int, site: str = "before_save",
+                           timeout: float = 15.0) -> None:
+        """Arm a storage failpoint to crash `mid` at its next
+        persistence pass (site: 'before_save' = the Ready batch is
+        lost; 'after_save' = persisted but never applied before the
+        crash — _replay must re-apply it) and wait for the member to
+        die."""
+        m = self.members[mid]
+        name = (m._fp_before_save if site == "before_save"
+                else m._fp_after_save)
+
+        def act(m=m, name=name):
+            m.crash()
+            raise FailpointPanic(name)
+
+        failpoint.enable(name, act)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if m._stopped.is_set():
+                break
+            time.sleep(0.01)
+        else:
+            failpoint.disable(name)
+            raise TimeoutError(
+                f"member {mid} did not hit failpoint {name}")
+        failpoint.disable(name)
+        router = self.routers.pop(mid, None)
+        if router is not None:
+            router.stop()
+
+    def restart(self, mid: int) -> MultiRaftMember:
+        """Fresh member on the crashed member's data_dir: boots through
+        _replay (WAL prefix + snapshots), re-attaches to the fabric."""
+        old = self.members[mid]
+        assert old._stopped.is_set(), f"member {mid} still running"
+        # Never leave this member's crash failpoints armed across the
+        # restart — the names are deterministic per member id, so the
+        # NEW member would crash at its first persistence pass too.
+        failpoint.disable(old._fp_before_save)
+        failpoint.disable(old._fp_after_save)
+        m = self._boot(mid)
+        m.start()
+        return m
+
+    # -- storage faults --------------------------------------------------------
+
+    def torn_tail(self, mid: int, max_chop: int = 24) -> int:
+        """Truncate the crashed member's LAST WAL segment at a
+        seed-chosen byte inside the written prefix — the torn record a
+        real crash mid-write leaves. Segments are preallocated, so the
+        cut is taken from the tail OFFSET captured at crash time, not
+        the file size. Returns the number of bytes chopped."""
+        m = self.members[mid]
+        assert m._stopped.is_set(), "torn_tail needs a crashed member"
+        tail = m._wal_tail_at_crash
+        wal_dir = os.path.join(self.data_dir, f"member-{mid}", "wal")
+        segs = sorted(f for f in os.listdir(wal_dir)
+                      if f.endswith(".wal"))
+        assert segs, "no WAL segments to tear"
+        path = os.path.join(wal_dir, segs[-1])
+        if tail <= 64:
+            return 0  # nothing beyond the segment header to tear
+        rng = self.plan.derived_rng(f"torn/{mid}")
+        chop = rng.randint(1, min(max_chop, tail - 64))
+        os.truncate(path, tail - chop)
+        _log.info("torn tail: member %d seg %s cut %d bytes at %d",
+                  mid, segs[-1], chop, tail - chop)
+        return chop
+
+    # -- workload --------------------------------------------------------------
+
+    def wait_leaders(self, timeout: float = 60.0) -> np.ndarray:
+        """Every group led by some live member (the shared
+        campaign-nudge convergence loop from hosting.py, restricted to
+        alive members)."""
+        return wait_group_leaders(self.alive, self.g, timeout=timeout)
+
+    def put(self, group: int, key: bytes, value: bytes,
+            timeout: float = 10.0) -> bool:
+        """Client write against whichever live member leads `group`;
+        an ack (True) means the proposer applied it — i.e. the entry
+        committed — and records it in the acked ledger. False = fate
+        unknown (timeout), legitimately either committed or not.
+        (Same propose/poll-apply retry discipline as
+        MultiRaftCluster.put, which raises on timeout instead of
+        returning False and keeps no ledger — under chaos a lost write
+        is an expected outcome, not an error.)"""
+        payload = GroupKV.put_payload(key, value)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for m in self.alive():
+                if not m.propose(group, payload):
+                    continue
+                sub = min(deadline, time.monotonic() + 1.0)
+                while time.monotonic() < sub:
+                    if m.get(group, key) == value:
+                        self.acked[(group, key)] = value
+                        self.acked_history.setdefault(
+                            (group, key), []).append(value)
+                        return True
+                    time.sleep(0.005)
+            time.sleep(0.02)
+        return False
+
+    def run_workload(self, n_ops: int, prefix: bytes = b"w",
+                     per_put_timeout: float = 8.0) -> int:
+        """Seeded unique-key put stream over seed-chosen groups;
+        returns the number of acked writes (the rest timed out under
+        faults — allowed, their fate is unconstrained)."""
+        rng = self.plan.derived_rng(f"workload/{prefix.decode()}")
+        acked = 0
+        for i in range(n_ops):
+            g = rng.randrange(self.g)
+            key = b"%s-%d" % (prefix, i)
+            val = b"v%d-%d" % (self.seed, i)
+            if self.put(g, key, val, timeout=per_put_timeout):
+                acked += 1
+        return acked
+
+    def touch_all_groups(self, prefix: bytes = b"touch",
+                         per_put_timeout: float = 10.0) -> int:
+        """One put per group — a convergence pass after torn-tail
+        recovery. Tearing bytes INSIDE the written (fsync'd, possibly
+        acked) prefix voids the durability assumption the leader's
+        progress tracker rests on: the leader still believes the torn
+        member matches up to its pre-crash ack, so an idle group never
+        gets re-replicated (there is no probe without traffic — real
+        raft has the same hole, which is why real torn tails only ever
+        lose UNsynced bytes). A write per group forces the append →
+        reject → backtrack → resend cycle that re-heals every log."""
+        acked = 0
+        for g in range(self.g):
+            if self.put(g, b"%s-g%d" % (prefix, g),
+                        b"t%d" % self.seed, timeout=per_put_timeout):
+                acked += 1
+        return acked
+
+    def stop(self) -> None:
+        self.fabric.stop()
+        for m in self.members.values():
+            m.stop()
+        for r in self.routers.values():
+            r.stop()
+
+
+def run_invariant_checks(harness: ChaosHarness,
+                         observer: Optional[LeaderObserver],
+                         expect_members: int,
+                         hash_timeout: float = 45.0,
+                         acked_timeout: float = 20.0,
+                         allow_lag: int = 0) -> None:
+    """Episode closer: the three chaos checkers in canonical order —
+    per-group KV-hash parity, committed-never-lost, then (when an
+    observer ran) at-most-one-leader-per-(group, term). Torn-tail
+    episodes pass observer=None: tearing fsync'd bytes voids the
+    durability assumption election safety rests on.
+
+    ``allow_lag=1`` relaxes both state checkers to quorum agreement —
+    for episodes that can trip the known restarted-leader progress
+    wedge (a follower pinned one entry behind with probe_sent stuck;
+    see ROADMAP open items and tools/repro_progress_wedge.py). Safety
+    (quorum durability, no divergent values, election safety) is still
+    fully asserted; only all-member convergence is relaxed."""
+    # Lazy: the checkers module pulls in the server stack, which the
+    # batched package must not import at module load.
+    from ..functional.checker import (
+        check_leader_claims,
+        committed_never_lost,
+        multiraft_hash_check,
+    )
+
+    members = harness.alive()
+    assert len(members) == expect_members, (
+        f"{len(members)} members alive at episode close, "
+        f"want {expect_members}")
+    multiraft_hash_check(members, timeout=hash_timeout,
+                         allow_lag=allow_lag)
+    committed_never_lost(members, harness.acked, timeout=acked_timeout,
+                         allow_lag=allow_lag,
+                         history=harness.acked_history)
+    if observer is not None:
+        observer.stop()
+        check_leader_claims(observer.conflicts)

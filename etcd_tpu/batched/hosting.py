@@ -30,6 +30,7 @@ import json
 import logging
 import os
 import queue as queue_mod
+import random
 import struct
 import threading
 import time
@@ -38,7 +39,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..native.walog import Walog, read_all as wal_read_all
+from ..native.walog import Walog, WalogError, read_all as wal_read_all
+from ..pkg.failpoint import FailpointPanic, fp
 from ..raft.types import Message, MessageType, Snapshot, SnapshotMetadata
 from .rawnode import BatchedRawNode, BatchedReady, RowRestore
 from .state import BatchedConfig, LEADER
@@ -163,6 +165,16 @@ class MultiRaftMember:
         self._send_block: Optional[Callable[[int, "object"], None]] = None
         self._lock = threading.Lock()
         self._work = threading.Event()  # wakes the round loop
+        # Simulated-kill flag (see crash()): once set (under _lock) the
+        # WAL handle is closed and every persistence/apply path bails
+        # out, so queued-but-unsaved Readys are lost like a real kill.
+        self._crashed = False
+        self._wal_tail_at_crash = 0  # last segment's write offset
+        # gofail-style storage failpoints on the persistence path
+        # (ref: etcdserver/raft.go raftBeforeSave/raftAfterSave); chaos
+        # harnesses enable them per-member by these names.
+        self._fp_before_save = f"hosting.m{member_id}.raftBeforeSave"
+        self._fp_after_save = f"hosting.m{member_id}.raftAfterSave"
         # Wall-seconds per phase of the member pipeline (ETCD_TPU_PROF
         # companion at the hosting layer; read via the admin 'prof' op).
         self.stats = {"rounds": 0, "round_s": 0.0, "wal_s": 0.0,
@@ -283,12 +295,21 @@ class MultiRaftMember:
         # ticks) wakes the loop immediately instead of a blind sleep —
         # a put proposed mid-sleep otherwise pays up to a quarter tick
         # of dead latency PER HOP of the commit path.
-        while not self._stopped.is_set():
-            if not self.rn.has_work():
-                self._work.wait(self.tick_interval)
-                self._work.clear()
-                continue
-            self.run_round()
+        try:
+            while not self._stopped.is_set():
+                if not self.rn.has_work():
+                    self._work.wait(self.tick_interval)
+                    self._work.clear()
+                    continue
+                self.run_round()
+        except FailpointPanic:
+            # Injected crash on the synchronous (pipeline=False) path.
+            # A site armed with the bare 'panic' action (not a crash()
+            # callable) reaches here with the member still live — finish
+            # the kill, or the member would wedge half-dead.
+            _log.info("member %d: injected crash (round loop)", self.id)
+            if not self._crashed:
+                self.crash()
 
     def _drain_loop(self) -> None:
         """Persist/apply/send worker: drains Readys in round order,
@@ -321,6 +342,16 @@ class MultiRaftMember:
                         return
                     batch.append(nxt)
                 self._process_readys(batch)
+        except FailpointPanic:
+            # Injected crash (chaos harness): exit WITHOUT the orderly
+            # stop() below, which would flush state a real kill would
+            # have torn away. If the site was armed with the bare
+            # 'panic' action (no crash() callable), the member is still
+            # live here — finish the kill, else run_round spins forever
+            # on the full _ready_q.
+            _log.info("member %d: injected crash (drain worker)", self.id)
+            if not self._crashed:
+                self.crash()
         except Exception:  # noqa: BLE001 — fatal: log + stop the member
             _log.exception(
                 "member %d: drain worker died; stopping member", self.id)
@@ -359,8 +390,11 @@ class MultiRaftMember:
     def _process_readys(self, batch: List[BatchedReady]) -> None:
         """Persist (one fsync for the whole batch) → apply → send, in
         round order."""
+        fp(self._fp_before_save)  # crash-before-WAL-save injection site
         t0 = time.perf_counter()
         with self._lock:
+            if self._crashed:
+                return  # simulated kill: queued Readys are torn away
             must_sync = False
             for rd in batch:
                 for row, term, vote, commit in rd.hardstates:
@@ -373,10 +407,13 @@ class MultiRaftMember:
                 self.wal.flush(sync=True)
         self.stats["wal_s"] += time.perf_counter() - t0
         self.stats["batched"] += len(batch)
+        fp(self._fp_after_save)  # crash-after-save-before-apply site
         for rd in batch:
             self._apply_and_send(rd)
 
     def _apply_and_send(self, rd: BatchedReady) -> None:
+        if self._crashed:
+            return  # dead members neither apply nor send
         t0 = time.perf_counter()
         with self._lock:
             # 2. apply committed payloads (persist already happened in
@@ -452,6 +489,11 @@ class MultiRaftMember:
             # stale entries into the freshly restored state.
             idx = m.snapshot.metadata.index
             with self._lock:
+                if self._stopped.is_set():
+                    # Re-check under _lock: a crash() that won the lock
+                    # first has closed the WAL handle this path appends
+                    # to (the unlocked check above is advisory only).
+                    return
                 if idx > self.applied_index[group]:
                     self.kvs[group].restore(m.snapshot.data)
                     self.applied_index[group] = idx
@@ -567,6 +609,47 @@ class MultiRaftMember:
                 self._read_cv.wait(rem)
         return self.kvs[group].data.get(key)
 
+    def crash(self) -> None:
+        """Simulated ``kill -9`` for chaos testing: mark the member dead
+        and close the WAL handle WITHOUT draining queued Readys — every
+        Ready still sitting in ``_ready_q`` (persist not yet run) is
+        torn away, exactly the suffix a real crash at this point loses.
+        The handle close releases the WAL dir flock so a restarted
+        member (a fresh ``MultiRaftMember`` on the same data_dir, booting
+        through ``_replay``) can take it in the same process. Closing an
+        idle handle flushes at most already-appended-unsynced bytes,
+        which only ever makes the survivor MORE durable — never less —
+        so no invariant can be violated by the simulation shortcut."""
+        with self._lock:
+            if self._stopped.is_set():
+                return
+            self._crashed = True
+            self._stopped.set()
+            try:
+                self._wal_tail_at_crash = self.wal.tail_offset()
+                self.wal.close()
+            except WalogError:
+                pass
+        self._work.set()
+        with self._read_cv:
+            self._read_cv.notify_all()
+        # Unpark the drain worker; queued Readys ahead of the sentinel
+        # are discarded by the _crashed gate. The put must be RELIABLE:
+        # a put_nowait swallowed by a full queue (crash mid-backpressure
+        # is the likeliest crash) parks the worker on get() forever once
+        # it drains the gated batches — and stop() after a crash returns
+        # at its _stopped check without ever enqueueing a sentinel. A
+        # crash FROM the drain worker itself (failpoint action) needs no
+        # sentinel: it is unwinding via FailpointPanic.
+        if (self._drainer is not None
+                and self._drainer is not threading.current_thread()):
+            while self._drainer.is_alive():
+                try:
+                    self._ready_q.put(None, timeout=0.2)
+                    break
+                except queue_mod.Full:
+                    continue
+
     def stop(self) -> None:
         # Atomic claim: concurrent stop() calls must not both proceed to
         # the WAL close (Event.is_set/set is a check-then-act race).
@@ -618,6 +701,22 @@ class InProcRouter:
         self.members: Dict[int, MultiRaftMember] = {}
         self._isolated: set = set()
         self._lock = threading.Lock()
+        # Per-member drop/error counters (ISSUE 2 satellite: a chaos
+        # run must be able to ASSERT that faults were exercised, and a
+        # production operator must see loss, not silence).
+        self._stats: Dict[int, Dict[str, int]] = {}
+
+    def _count(self, member_id: int, key: str, n: int = 1) -> None:
+        with self._lock:
+            d = self._stats.setdefault(member_id, {})
+            d[key] = d.get(key, 0) + n
+
+    def stats(self) -> Dict[int, Dict[str, int]]:
+        """Per-member counters: isolated_drop (suppressed by
+        isolate()), no_route (target not attached), deliver_error
+        (exception swallowed on the deliver path)."""
+        with self._lock:
+            return {mid: dict(d) for mid, d in self._stats.items()}
 
     def attach(self, m: MultiRaftMember) -> None:
         self.members[m.id] = m
@@ -627,34 +726,58 @@ class InProcRouter:
     def send(self, from_id: int, batch: List[Tuple[int, Message]]) -> None:
         with self._lock:
             if from_id in self._isolated:
-                return
-            targets = {
-                to: mem for to, mem in self.members.items()
-                if to not in self._isolated
-            }
+                sender_isolated = True
+                targets = {}
+            else:
+                sender_isolated = False
+                targets = {
+                    to: mem for to, mem in self.members.items()
+                    if to not in self._isolated
+                }
+        if sender_isolated:
+            self._count(from_id, "isolated_drop", len(batch))
+            return
         for group, msg in batch:
             mem = targets.get(msg.to)
-            if mem is not None:
-                try:
-                    mem.deliver(group, msg)
-                except Exception:  # noqa: BLE001 — drop, like a lossy net
-                    pass
+            if mem is None:
+                self._count(
+                    from_id,
+                    "isolated_drop" if msg.to in self.members
+                    else "no_route",
+                )
+                continue
+            try:
+                mem.deliver(group, msg)
+            except Exception:  # noqa: BLE001 — drop, like a lossy net
+                self._count(from_id, "deliver_error")
 
     def send_block(self, from_id: int, blk) -> None:
         with self._lock:
             if from_id in self._isolated:
-                return
-            targets = {
-                to: mem for to, mem in self.members.items()
-                if to not in self._isolated
-            }
+                sender_isolated = True
+                targets = {}
+            else:
+                sender_isolated = False
+                targets = {
+                    to: mem for to, mem in self.members.items()
+                    if to not in self._isolated
+                }
+        if sender_isolated:
+            self._count(from_id, "isolated_drop", len(blk))
+            return
         for to, sub in blk.split_by_target().items():
             mem = targets.get(to)
-            if mem is not None:
-                try:
-                    mem.deliver_block(sub)
-                except Exception:  # noqa: BLE001 — drop, like a lossy net
-                    pass
+            if mem is None:
+                self._count(
+                    from_id,
+                    "isolated_drop" if to in self.members else "no_route",
+                    len(sub),
+                )
+                continue
+            try:
+                mem.deliver_block(sub)
+            except Exception:  # noqa: BLE001 — drop, like a lossy net
+                self._count(from_id, "deliver_error", len(sub))
 
     def isolate(self, member_id: int) -> None:
         with self._lock:
@@ -676,6 +799,15 @@ class TCPRouter:
 
     MAX_PENDING = 16384
     BLOCK_SENTINEL = 0xFFFFFFFF  # group-id marker for SoA block frames
+    # Sender redial policy: bounded exponential backoff with ±50%
+    # jitter (ref: rafthttp's probing/backoff discipline — a dead peer
+    # must not be hammered at full rate, a recovered one must be found
+    # within ~a second), capped per frame by REDIAL_BUDGET so a long
+    # outage degrades to drop-don't-block instead of queue collapse.
+    # Backoff sleeps use _stopped.wait, so stop() never waits on one.
+    BACKOFF_BASE = 0.05
+    BACKOFF_CAP = 1.0
+    REDIAL_BUDGET = 3.0
     # Per-peer sender lanes (PriorityQueue; FIFO within a lane via the
     # monotone sequence number). Liveness traffic — the SoA block
     # frames carrying heartbeats/acks/votes — outranks bulk MsgApp
@@ -700,6 +832,11 @@ class TCPRouter:
         member._send_block = self.send_block
         self._stopped = threading.Event()
         self._lock = threading.Lock()
+        # Fabric loss/error counters (never silently pass): queue-full
+        # drops, oversize drops, dial failures, per-frame redial-budget
+        # drops, send errors, corrupt inbound frames, deliver errors.
+        self._stats: Dict[str, int] = {}
+        self._stats_lock = threading.Lock()
         # peer id -> (queue, sender thread); established lazily.
         self._peers: Dict[int, "object"] = {}
         self._addrs: Dict[int, Tuple[str, int]] = {}
@@ -715,6 +852,17 @@ class TCPRouter:
     def add_peer(self, peer_id: int, addr: Tuple[str, int]) -> None:
         with self._lock:
             self._addrs[peer_id] = addr
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[key] = self._stats.get(key, 0) + n
+
+    def stats(self) -> Dict[str, int]:
+        """Loss/error counters for this member's fabric (the TCP analog
+        of InProcRouter.stats); chaos tests assert these move, operators
+        read them through the admin 'stats' op."""
+        with self._stats_lock:
+            return dict(self._stats)
 
     # -- outbound --------------------------------------------------------------
 
@@ -736,12 +884,13 @@ class TCPRouter:
         for group, m in batch:
             q2 = queues.get(m.to)
             if q2 is None:
+                self._count("no_route")
                 continue
             try:
                 q2.put_nowait((self.PRIO_BULK, next(self._seq),
                                (group, m)))
             except _q.Full:  # drop, never block the round loop
-                pass
+                self._count("queue_full_drop")
 
     def send_block(self, _from_id: int, blk) -> None:
         """Ship a SoA block: pre-encoded frames per target member (vs
@@ -778,17 +927,20 @@ class TCPRouter:
                         prio)
                 return
             if len(body) + 8 > self._max_frame:
-                return  # single unsendable record: drop (raft retries)
+                # single unsendable record: drop (raft retries)
+                self._count("oversize_drop")
+                return
             frame = struct.pack(
                 "<II", len(body) + 4, self.BLOCK_SENTINEL) + body
             try:
                 q2.put_nowait((prio, next(self._seq), frame))
             except _q.Full:  # drop, never block the round loop
-                pass
+                self._count("queue_full_drop", len(sub))
 
         for to, sub in subs.items():
             q2 = queues.get(to)
             if q2 is None:
+                self._count("no_route", len(sub))
                 continue
             has_ents = sub.rec["n_ents"] > 0
             if has_ents.any():
@@ -823,7 +975,16 @@ class TCPRouter:
         return ent
 
     def _sender(self, peer_id: int, addr: Tuple[str, int], q) -> None:
+        """Per-peer sender lane. A down peer is redialed with bounded
+        exponential backoff + jitter (state carries across frames so a
+        long outage settles at BACKOFF_CAP instead of hammering), each
+        frame charged at most REDIAL_BUDGET of redial time before it is
+        dropped (drop-don't-block, ref: etcdserver/raft.go:108-111).
+        Backoff sleeps are _stopped.wait()s: stop() interrupts them, so
+        shutdown never serves out a backoff."""
+        rng = random.Random()  # jitter decorrelates peers; not seeded
         sock = None
+        backoff = self.BACKOFF_BASE
         while not self._stopped.is_set():
             _prio, _seq, item = q.get()
             if item is None:
@@ -841,30 +1002,79 @@ class TCPRouter:
                     # oversized frame and the resend would churn it
                     # forever; drop it here instead (the raft layer
                     # retries via snapshots).
+                    self._count("oversize_drop")
                     continue
                 frame = (
                     struct.pack("<II", len(payload) + 4, group) + payload
                 )
-            for _attempt in (0, 1):
+            deadline = time.monotonic() + self.REDIAL_BUDGET
+            while not self._stopped.is_set():
                 if sock is None:
                     try:
                         sock = self._socket.create_connection(
                             addr, timeout=2.0)
+                        if (sock.getsockname()
+                                == sock.getpeername()):
+                            # TCP simultaneous-open self-connect:
+                            # while the peer's listener is down, the
+                            # kernel can hand the dial OUR ephemeral
+                            # source port == the target port,
+                            # connecting the socket to itself. Writes
+                            # then "succeed" into our own receive
+                            # buffer and deliver nothing — a silently
+                            # dead lane (found by the chaos harness:
+                            # a follower wedged one entry behind with
+                            # zero errors counted).
+                            self._count("self_connect")
+                            try:
+                                sock.close()
+                            except OSError:
+                                pass
+                            sock = None
+                            raise OSError("tcp self-connect")
                         sock.setsockopt(
                             self._socket.IPPROTO_TCP,
                             self._socket.TCP_NODELAY, 1)
                     except OSError:
                         sock = None
-                        break  # drop; next message retries the dial
+                        self._count("dial_fail")
+                        delay = backoff * (0.5 + rng.random())
+                        backoff = min(backoff * 2, self.BACKOFF_CAP)
+                        if time.monotonic() + delay > deadline:
+                            # Budget exhausted: drop THIS frame but keep
+                            # the backoff state — the next frame resumes
+                            # the slow probe instead of re-hammering.
+                            self._count("redial_drop")
+                            break
+                        if self._stopped.wait(delay):
+                            break
+                        continue
                 try:
                     sock.sendall(frame)
+                    # Only a delivered frame proves the peer healthy:
+                    # resetting on dial success would let a peer that
+                    # accepts connections but RSTs every write erase
+                    # the backoff each cycle — a full-speed
+                    # dial/send/reset spin.
+                    backoff = self.BACKOFF_BASE
                     break
                 except OSError:
                     try:
                         sock.close()
                     except OSError:
                         pass
-                    sock = None  # reconnect once, else drop
+                    sock = None
+                    self._count("send_error")
+                    delay = backoff * (0.5 + rng.random())
+                    backoff = min(backoff * 2, self.BACKOFF_CAP)
+                    if time.monotonic() + delay > deadline:
+                        # A peer that accepts dials but resets every
+                        # send must not pin this lane to one frame.
+                        self._count("redial_drop")
+                        break
+                    if self._stopped.wait(delay):
+                        break
+                    continue  # redial under the same frame budget
         if sock is not None:
             try:
                 sock.close()
@@ -910,6 +1120,7 @@ class TCPRouter:
                 break
             (total,) = struct.unpack("<I", hdr)
             if not 4 <= total <= self._max_frame:
+                self._count("recv_corrupt")
                 break
             body = read_exact(total)
             if body is None:
@@ -921,20 +1132,22 @@ class TCPRouter:
                 try:
                     blk = MsgBlock.from_bytes(body[4:])
                 except ValueError:  # corrupt frame: drop conn
+                    self._count("recv_corrupt")
                     break
                 try:
                     self.member.deliver_block(blk)
                 except Exception:  # noqa: BLE001 — lossy-net semantics
-                    pass
+                    self._count("deliver_error")
                 continue
             try:
                 m = self._dec(body[4:])
             except Exception:  # noqa: BLE001 — corrupt frame: drop conn
+                self._count("recv_corrupt")
                 break
             try:
                 self.member.deliver(group, m)
             except Exception:  # noqa: BLE001 — lossy-net semantics
-                pass
+                self._count("deliver_error")
         try:
             conn.close()
         except OSError:
@@ -965,6 +1178,36 @@ class TCPRouter:
             t.join(timeout=2)
 
 
+def wait_group_leaders(members_fn, num_groups: int,
+                       timeout: float = 60.0,
+                       nudge_interval: float = 5.0) -> np.ndarray:
+    """Block until every group has an elected leader among the members
+    ``members_fn()`` returns; returns the per-group leader member id.
+    Under heavy host load device rounds can lag the tick clock, so
+    leaderless groups are periodically nudged with an explicit campaign
+    on every member (any single member's replica may be unelectable —
+    shorter log after a restart; pre-vote keeps the extra campaigns
+    from disrupting groups that elect meanwhile). Shared by
+    MultiRaftCluster and the chaos harness so their convergence
+    behavior can't drift apart."""
+    deadline = time.monotonic() + timeout
+    next_nudge = time.monotonic() + nudge_interval
+    while time.monotonic() < deadline:
+        leads = np.zeros(num_groups, np.int64)
+        for m in members_fn():
+            _term, role, _lead = m.rn.m_view
+            leads[role == LEADER] = m.id
+        if (leads > 0).all():
+            return leads
+        if time.monotonic() >= next_nudge:
+            stuck = np.nonzero(leads == 0)[0]
+            for m in members_fn():
+                m.campaign(stuck)
+            next_nudge = time.monotonic() + nudge_interval
+        time.sleep(0.05)
+    raise TimeoutError("groups without leader")
+
+
 class MultiRaftCluster:
     """Convenience harness: R members × G groups in one process."""
 
@@ -987,31 +1230,11 @@ class MultiRaftCluster:
 
     def wait_leaders(self, timeout: float = 60.0) -> np.ndarray:
         """Block until every group has an elected leader; returns the
-        per-group leader member id. Under heavy host load device rounds
-        can lag the tick clock, so leaderless groups are periodically
-        nudged with an explicit campaign (the hosting analog of etcd
-        clients retrying against a leaderless cluster)."""
-        deadline = time.monotonic() + timeout
+        per-group leader member id (the hosting analog of etcd clients
+        retrying against a leaderless cluster)."""
         g = next(iter(self.members.values())).g
-        next_nudge = time.monotonic() + 5.0
-        while time.monotonic() < deadline:
-            leads = np.zeros(g, np.int64)
-            for m in self.members.values():
-                mask = m.rn.m_role == LEADER
-                leads[mask] = m.id
-            if (leads > 0).all():
-                return leads
-            if time.monotonic() >= next_nudge:
-                stuck = np.nonzero(leads == 0)[0]
-                # Campaign the stuck groups on every member: any single
-                # member's replica may be unelectable (shorter log after
-                # a restart); pre-vote keeps the extra campaigns from
-                # disrupting groups that elect meanwhile.
-                for m in self.members.values():
-                    m.campaign(stuck)
-                next_nudge = time.monotonic() + 5.0
-            time.sleep(0.05)
-        raise TimeoutError("groups without leader")
+        return wait_group_leaders(
+            self.members.values, g, timeout=timeout)
 
     def put(self, group: int, key: bytes, value: bytes,
             timeout: float = 10.0) -> None:

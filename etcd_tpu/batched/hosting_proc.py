@@ -145,6 +145,17 @@ class AdminServer:
             if m.rn.prof:
                 st.update({f"rn_{k}": v for k, v in m.rn.prof.items()})
             return {"ok": True, "stats": st}
+        if op == "stats":
+            # Loss/error observability (ISSUE 2 satellite): member
+            # pipeline stats + the fabric's drop counters — queue-full
+            # drops, dial failures, redial-budget drops, send errors —
+            # so operators see loss instead of silence.
+            rstats = {}
+            rs = getattr(self.router, "stats", None)
+            if callable(rs):
+                rstats = rs()
+            return {"ok": True, "member": dict(m.stats),
+                    "router": rstats}
         if op == "bench":
             return self._bench(int(req["n"]),
                                int(req.get("value_size", 64)),

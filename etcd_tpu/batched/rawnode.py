@@ -222,6 +222,13 @@ class BatchedRawNode:
         self.m_snap = np.full(self.n, start_index, np.int64)
         self.m_role = np.zeros(self.n, np.int64)
         self.m_lead = np.zeros(self.n, np.int64)
+        # Consistent (term, role, lead) triple for observers: the
+        # individual mirrors above are swapped by TWO statements in
+        # advance(), so a foreign thread reading them pairwise can see
+        # role from round k and term from round k-1 — a phantom
+        # "leader at the old term". One tuple assignment is atomic.
+        self.m_view: Tuple[np.ndarray, np.ndarray, np.ndarray] = (
+            self.m_term, self.m_role, self.m_lead)
         self.m_ring = np.zeros((self.n, cfg.window), np.int64)
         self.applied = np.full(self.n, start_index, np.int64)
         self.stable = np.full(self.n, start_index, np.int64)
@@ -754,6 +761,7 @@ class BatchedRawNode:
             # install_snapshot_state, and read the mirrors.
             self.m_term, self.m_vote, self.m_commit = term, vote, commit
             self.m_last, self.m_role, self.m_lead = last, role, lead
+            self.m_view = (term, role, lead)
             self.m_snap, self.m_ring = snap_i, ring64
             self.applied = np.maximum(self.applied, commit)
             self.stable = last.copy()

@@ -9,10 +9,21 @@ failpoints, and the tester loop is `run_case`.
 """
 
 from .cluster import Cluster
-from .checker import hash_check, lease_expire_check, linearizable_check
+from .checker import (
+    check_leader_claims,
+    check_sequential_history,
+    committed_never_lost,
+    hash_check,
+    kv_map_hash,
+    lease_expire_check,
+    linearizable_check,
+    multiraft_hash_check,
+)
 from .stresser import KVStresser, LeaseStresser
 
 __all__ = [
     "Cluster", "KVStresser", "LeaseStresser",
     "hash_check", "lease_expire_check", "linearizable_check",
+    "kv_map_hash", "multiraft_hash_check", "committed_never_lost",
+    "check_leader_claims", "check_sequential_history",
 ]

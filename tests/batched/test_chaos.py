@@ -1,0 +1,266 @@
+"""Seeded chaos over the batched multi-raft hosting path (ISSUE 2).
+
+Quick deterministic subset — runs in tier-1. The long multi-seed soak
+with the full fault matrix lives in test_chaos_soak.py behind `-m slow`.
+Reproduce a failing seed with ETCD_TPU_CHAOS_SEED=<seed[,seed...]>.
+
+Every episode ends with the three checkers: per-group KV-hash parity,
+committed-never-lost (every acked write survives on every member), and
+at-most-one-leader-per-(group, term).
+
+All tests share ONE BatchedConfig so the jitted round program compiles
+once per pytest process (_step_round_jit is cached per config).
+"""
+
+import os
+import time
+
+import pytest
+
+from etcd_tpu.batched.faults import (
+    ChaosHarness,
+    FaultSpec,
+    LeaderObserver,
+    run_invariant_checks,
+)
+from etcd_tpu.batched.state import BatchedConfig
+from etcd_tpu.functional import (
+    check_sequential_history,
+    multiraft_hash_check,
+)
+from etcd_tpu.pkg import failpoint
+from etcd_tpu.pkg.errors import NotLeaderError
+
+pytestmark = pytest.mark.chaos
+
+G, R = 8, 3
+CFG = BatchedConfig(
+    num_groups=G, num_replicas=R, window=16, max_ents_per_msg=4,
+    max_props_per_round=4, election_timeout=10, heartbeat_timeout=1,
+    pre_vote=True, check_quorum=True, auto_compact=True,
+)
+
+SEEDS = tuple(
+    int(s) for s in
+    os.environ.get("ETCD_TPU_CHAOS_SEED", "101,202").split(",")
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def make_harness(tmp_path, seed, spec, transport="inproc"):
+    return ChaosHarness(
+        str(tmp_path), seed, spec, num_members=R, num_groups=G,
+        cfg=CFG, transport=transport,
+    )
+
+
+def run_checkers(h, obs):
+    run_invariant_checks(h, obs, expect_members=R)
+
+
+MSG_FAULTS = FaultSpec(drop=0.05, dup=0.05, delay=0.08,
+                       delay_max_s=0.04, reorder=0.2)
+
+
+class TestMessageFaults:
+    """Per-link drop/duplicate/delay/reorder under a live workload."""
+
+    @pytest.mark.parametrize("transport,seed", [
+        ("inproc", SEEDS[0]),
+        ("inproc", SEEDS[-1]),
+        ("tcp", SEEDS[0]),
+    ])
+    def test_faulty_links_converge(self, tmp_path, transport, seed):
+        h = make_harness(tmp_path, seed, MSG_FAULTS, transport)
+        obs = LeaderObserver(h.alive)
+        try:
+            h.wait_leaders()
+            obs.start()
+            acked = h.run_workload(20)
+            # Faults are lossy, not fatal: a majority of writes lands.
+            assert acked >= 10, f"only {acked}/20 writes acked"
+            h.plan.quiesce()
+            run_checkers(h, obs)
+            # Satellite: the fault plane must PROVE it injected — and
+            # the routers must count, not silently pass.
+            stats = h.fabric.stats()
+            assert stats.get("dropped", 0) > 0, stats
+            assert stats.get("delayed", 0) > 0, stats
+            if transport == "inproc":
+                assert isinstance(h.inproc.stats(), dict)
+            else:
+                for r in h.routers.values():
+                    assert isinstance(r.stats(), dict)
+        finally:
+            obs.stop()
+            h.stop()
+
+    def test_asymmetric_partition_heals(self, tmp_path):
+        """A half-open link (m1 hears m2, m2 never hears m1) must not
+        wedge the cluster or diverge state."""
+        seed = SEEDS[0]
+        h = make_harness(tmp_path, seed, FaultSpec())
+        obs = LeaderObserver(h.alive)
+        try:
+            h.wait_leaders()
+            obs.start()
+            h.run_workload(6, prefix=b"pre")
+            h.plan.partition(1, 2, symmetric=False)
+            acked = h.run_workload(8, prefix=b"cut")
+            assert acked >= 4
+            h.plan.quiesce()
+            h.run_workload(3, prefix=b"post")
+            run_checkers(h, obs)
+            assert h.fabric.stats().get("partitioned", 0) > 0
+        finally:
+            obs.stop()
+            h.stop()
+
+
+class TestCrashRestart:
+    """Storage-failpoint crashes + restart through _replay."""
+
+    @pytest.mark.parametrize("site", ["before_save", "after_save"])
+    def test_failpoint_crash_then_replay(self, tmp_path, site):
+        seed = SEEDS[0]
+        h = make_harness(tmp_path, seed, FaultSpec())
+        obs = LeaderObserver(h.alive)
+        try:
+            h.wait_leaders()
+            obs.start()
+            h.run_workload(6, prefix=b"pre")
+            h.crash_on_failpoint(2, site)
+            assert h.members[2]._crashed
+            # Quorum survives; writes keep committing without member 2.
+            acked = h.run_workload(6, prefix=b"mid")
+            assert acked >= 3
+            h.restart(2)  # boots through _replay on the torn-away WAL
+            h.wait_leaders()
+            h.run_workload(3, prefix=b"post")
+            run_checkers(h, obs)
+        finally:
+            obs.stop()
+            h.stop()
+
+
+class TestBarePanicFailpoint:
+    def test_default_panic_action_kills_member_cleanly(self, tmp_path):
+        """A site armed with the DEFAULT 'panic' action (no crash()
+        callable, unlike crash_on_failpoint) must kill the member
+        outright — not leave it half-dead with run_round spinning on a
+        full _ready_q forever."""
+        h = make_harness(tmp_path, SEEDS[0], FaultSpec())
+        try:
+            h.wait_leaders()
+            victim = h.members[2]
+            failpoint.enable(victim._fp_before_save)  # action: panic
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if victim._stopped.is_set():
+                    break
+                time.sleep(0.01)
+            assert victim._stopped.is_set(), "member wedged half-dead"
+            assert victim._crashed
+            victim._runner.join(timeout=10)
+            assert not victim._runner.is_alive()
+            h.restart(2)  # restart() disables the armed sites
+            h.wait_leaders()
+        finally:
+            h.stop()
+
+
+class TestTornTail:
+    def test_torn_wal_tail_recovers_prefix(self, tmp_path):
+        """Crash a member, truncate its last WAL segment at an
+        arbitrary byte (a torn write), and verify restart recovers the
+        valid prefix through wal_read_all's repair instead of raising —
+        then the survivors re-replicate the torn-away tail."""
+        seed = SEEDS[0]
+        h = make_harness(tmp_path, seed, FaultSpec())
+        try:
+            h.wait_leaders()
+            h.run_workload(8, prefix=b"pre")
+            h.crash(3)
+            chop = h.torn_tail(3)
+            assert chop > 0, "expected a non-empty WAL tail to tear"
+            h.run_workload(4, prefix=b"mid")
+            h.restart(3)  # must NOT raise on the torn segment
+            h.wait_leaders()
+            # The chop may tear ACKED bytes (beyond raft's durability
+            # contract); a write per group re-heals every log via the
+            # leader's conflict probe — see touch_all_groups.
+            h.touch_all_groups()
+            # Hash parity + no acked write lost (the torn member's
+            # missing suffix comes back from the quorum); observer=None
+            # scopes out the leader checker — see run_invariant_checks.
+            run_invariant_checks(h, None, expect_members=R)
+        finally:
+            h.stop()
+
+
+class TestLinearizableFailover:
+    def test_reads_never_stale_across_leader_loss(self, tmp_path):
+        """linearizable_get during leader loss raises NotLeaderError or
+        TimeoutError cleanly — never returns stale data; after
+        re-election reads see the newest acked write. The observed
+        history replays clean through the sequential checker."""
+        seed = SEEDS[0]
+        h = make_harness(tmp_path, seed, FaultSpec())
+        history = []
+
+        def lread(m, g, key, timeout=2.0):
+            try:
+                got = m.linearizable_get(g, key, timeout=timeout)
+                history.append(("r", key, got, True))
+                return got
+            except (NotLeaderError, TimeoutError) as e:
+                history.append(("r", key, type(e).__name__, False))
+                return None
+
+        try:
+            leads = h.wait_leaders()
+            g = 0
+            old = h.members[int(leads[g])]
+            assert h.put(g, b"reg", b"v1")
+            history.append(("w", b"reg", b"v1"))
+            assert lread(old, g, b"reg") == b"v1"
+
+            # Cut the leader off. Its linearizable reads must fail
+            # cleanly (Timeout while it still claims the lease-less
+            # lead, NotLeader once check-quorum steps it down).
+            h.plan.isolate_member(old.id, h.members.keys())
+            lread(old, g, b"reg", timeout=1.0)
+
+            # Survivors elect and accept the next write.
+            assert h.put(g, b"reg", b"v2", timeout=30.0)
+            history.append(("w", b"reg", b"v2"))
+            deadline = time.monotonic() + 30.0
+            new = None
+            while time.monotonic() < deadline and new is None:
+                for m in h.alive():
+                    if m.id != old.id and m.is_leader(g):
+                        new = m
+                        break
+                time.sleep(0.02)
+            assert new is not None, "no replacement leader elected"
+            assert lread(new, g, b"reg", timeout=10.0) == b"v2"
+
+            # Healed old leader: reads either redirect (NotLeader) or,
+            # if it wins leadership back, must see v2 — never v1.
+            h.plan.quiesce()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if old.get(g, b"reg") == b"v2":
+                    break
+                time.sleep(0.02)
+            lread(old, g, b"reg", timeout=5.0)
+
+            check_sequential_history(history)
+            multiraft_hash_check(h.alive(), timeout=45.0)
+        finally:
+            h.stop()

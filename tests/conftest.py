@@ -44,3 +44,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running soak/differential suites"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection episodes over the batched "
+        "multi-raft hosting path (quick subset in tier-1; the full "
+        "matrix soak is also marked slow; reproduce a failing seed "
+        "with ETCD_TPU_CHAOS_SEED)"
+    )

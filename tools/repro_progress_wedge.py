@@ -1,0 +1,95 @@
+"""Reproducer: restarted-member progress wedge on the TCP hosting path.
+
+Found by the ISSUE 2 chaos harness. Symptom: after a chaos episode with
+member restarts over TCP, one (group, follower) pair wedges — the
+follower sits a suffix behind forever while the leader never re-sends.
+
+Signature on the leader (observed via rn.state at stuck time):
+
+* ``next[slot] == match[slot]`` — an ILLEGAL raft progress state
+  (next must always be >= match + 1),
+* ``pr_state[slot] == PROBE`` with ``probe_sent[slot]`` pinned True,
+* zero object-path (T_APP/T_SNAP) messages emitted toward the lagger
+  (verified by spying member._send), while block-path heartbeats flow
+  both ways and the lagger's hb_resp + stale app_resp records verifiably
+  arrive at the leader's deliver_block every tick.
+
+Exonerated by instrumentation (see CHANGES.md PR 2):
+
+* transport: sender-lane queues empty, frames delivered, the TCP
+  self-connect bug is fixed and counted (stats()['self_connect']);
+* host staging: records pass validate_block into the dense inbox;
+* remediation: poke_append, a fresh write to the group, and
+  transfer_leader all fail to unwedge; a SYNTHETIC object-path
+  MsgHeartbeatResp injected straight into rn.step() also fails —
+  the wedge is in the device round's resp->probe->emit interplay.
+
+Run (fails with a diagnostic dump when the wedge reproduces; ~10-30%
+of attempts on a loaded CPU):
+
+    JAX_PLATFORMS=cpu python tools/repro_progress_wedge.py
+"""
+
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+from etcd_tpu.batched.faults import ChaosHarness, FaultSpec  # noqa: E402
+from etcd_tpu.functional import multiraft_hash_check  # noqa: E402
+
+
+def main(attempts: int = 10, base_seed: int = 424242) -> int:
+    spec = FaultSpec(drop=0.06, dup=0.06, delay=0.1,
+                     delay_max_s=0.05, reorder=0.25)
+    for attempt in range(attempts):
+        d = tempfile.mkdtemp(prefix="wedge-repro-")
+        h = ChaosHarness(d, seed=base_seed + attempt, spec=spec,
+                         num_members=3, num_groups=12, transport="tcp")
+        try:
+            h.wait_leaders()
+            h.run_workload(15, prefix=b"vfy")
+            h.crash_on_failpoint(2, "after_save")
+            h.run_workload(6, prefix=b"mid", per_put_timeout=15.0)
+            h.restart(2)
+            h.wait_leaders()
+            h.crash(3)
+            h.torn_tail(3)
+            h.restart(3)
+            h.wait_leaders()
+            h.touch_all_groups()
+            h.plan.quiesce()
+            try:
+                multiraft_hash_check(h.alive(), timeout=25.0)
+                print(f"attempt {attempt}: converged")
+            except AssertionError as e:
+                print(f"attempt {attempt}: WEDGED -> {e}")
+                applied = np.stack(
+                    [m.applied_index for m in h.alive()])
+                g = int(np.nonzero(
+                    (applied != applied[0]).any(axis=0))[0][0])
+                _t, _r, lead = h.members[1].rn.m_view
+                leader = h.members[int(lead[g])]
+                lagger = min(h.alive(),
+                             key=lambda m: int(m.applied_index[g]))
+                st = leader.rn.state
+                print(f"  g{g} leader=m{leader.id} lagger=m{lagger.id}")
+                print(f"  match={np.asarray(st.match[g]).tolist()} "
+                      f"next={np.asarray(st.next[g]).tolist()} "
+                      f"pr_state={np.asarray(st.pr_state[g]).tolist()} "
+                      f"probe_sent="
+                      f"{np.asarray(st.probe_sent[g]).tolist()} "
+                      f"snap_index={int(np.asarray(st.snap_index[g]))}")
+                return 1
+        finally:
+            h.stop()
+    print("no repro — wedge is timing-dependent; re-run or raise "
+          "attempts")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
