@@ -81,12 +81,14 @@ def _ensure_live_backend() -> None:
 
 
 def _make_engine(groups: int, lanes_minor: bool,
-                 merged_deliver: bool = False):
+                 merged_deliver: bool = False,
+                 telemetry: bool = False):
     # Canonical config + setup shared with tools/frontier_sweep.py so
     # the two tools' numbers stay methodologically comparable.
     from etcd_tpu.tools.benchlib import make_bench_engine
 
-    return make_bench_engine(groups, lanes_minor, merged_deliver)
+    return make_bench_engine(groups, lanes_minor, merged_deliver,
+                             telemetry=telemetry)
 
 
 def _rate(eng, props, rounds_per_call: int, calls: int,
@@ -127,6 +129,14 @@ def main() -> None:
     if pipe_env and pipe_env not in ("0", "1"):
         raise SystemExit(f"BENCH_PIPELINE must be 0|1, got {pipe_env!r}")
     pipelined = pipe_env == "1"
+    # BENCH_TELEMETRY=1 compiles the kernel telemetry plane (ISSUE 4)
+    # into the measured round — the overhead-measurement knob backing
+    # the BENCH_NOTES telemetry-off/on row. Headline default: off.
+    tel_env = os.environ.get("BENCH_TELEMETRY", "")
+    if tel_env and tel_env not in ("0", "1"):
+        raise SystemExit(
+            f"BENCH_TELEMETRY must be 0|1, got {tel_env!r}")
+    telemetry = tel_env == "1"
     cached = None  # (eng, props) reusable for the main run
     if layout_env:
         lanes_minor = layout_env == "minor"
@@ -145,7 +155,8 @@ def main() -> None:
         for lm in (False, True):
             try:
                 t0 = time.perf_counter()
-                engines[lm] = _make_engine(min(groups, 4096), lm, merged)
+                engines[lm] = _make_engine(min(groups, 4096), lm, merged,
+                                           telemetry)
                 _note(f"probe layout={'minor' if lm else 'major'} "
                       f"built+compiled in {time.perf_counter()-t0:.1f}s")
                 rates[lm] = _rate(*engines[lm], 8, 2)
@@ -164,13 +175,15 @@ def main() -> None:
     else:
         try:
             t0 = time.perf_counter()
-            eng, props = _make_engine(groups, lanes_minor, merged)
+            eng, props = _make_engine(groups, lanes_minor, merged,
+                                      telemetry)
         except Exception as e:  # noqa: BLE001 — one-shot layout fallback
             _note(f"layout={'minor' if lanes_minor else 'major'} failed "
                   f"({e!r}); falling back to the other layout")
             lanes_minor = not lanes_minor
             t0 = time.perf_counter()
-            eng, props = _make_engine(groups, lanes_minor, merged)
+            eng, props = _make_engine(groups, lanes_minor, merged,
+                                      telemetry)
         _note(f"main G={groups} built+compiled in {time.perf_counter()-t0:.1f}s")
     rate = _rate(eng, props, 16, 8, pipelined=pipelined)
     _note(f"main rate: {rate:.0f} group-rounds/s")
@@ -191,6 +204,7 @@ def main() -> None:
                     f"layout={'minor' if lanes_minor else 'major'}, "
                     f"deliver={'merged' if merged else 'six'}, "
                     f"loop={'pipelined' if pipelined else 'serial'}, "
+                    f"telemetry={'on' if telemetry else 'off'}, "
                     f"commit_p50={commit_p50_ms:.2f}ms/{rounds}r)"
                 ),
                 "vs_baseline": round(rate / 1e6, 4),

@@ -28,6 +28,11 @@ program:
                drop/dup/delay/reorder/partition, storage-failpoint
                crashes, torn-tail WAL injection, kill/restart cycles
                (the functional tester's fault matrix, batched).
+- ``telemetry``: the device→host observability plane — kernel event
+               counters + on-device invariant bitmap behind
+               ``BatchedConfig.telemetry``, folded into the shared
+               ``pkg.metrics`` registry by ``TelemetryHub`` with a
+               bounded flight recorder (``artifacts/flightrec_*.json``).
 """
 
 from .state import BatchedConfig, BatchedState, init_state  # noqa: F401
@@ -42,4 +47,10 @@ from .faults import (  # noqa: F401
     FaultSpec,
     FaultyFabric,
     LeaderObserver,
+)
+from .telemetry import (  # noqa: F401
+    INV_NAMES,
+    TM_INDEX,
+    TM_NAMES,
+    TelemetryHub,
 )

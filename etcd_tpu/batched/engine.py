@@ -42,26 +42,42 @@ class MultiRaftEngine:
         n = cfg.num_instances
         self._zeros_b = jnp.zeros((n,), bool)
         self._zeros_i = jnp.zeros((n,), I32)
+        # In-device telemetry accumulator (cfg.telemetry): per-instance
+        # counter totals + OR-folded invariant bitmaps, accumulated
+        # inside the closed-loop scan with no per-round host sync.
+        if cfg.telemetry:
+            from .telemetry import NUM_COUNTERS
 
-        def closed_loop(st, inbox, ticks, props, rounds):
+            self._tel_counters = jnp.zeros((n, NUM_COUNTERS), I32)
+            self._tel_invariants = jnp.zeros((n,), I32)
+        self.telemetry_hub = None
+
+        def closed_loop(st, inbox, ticks, props, tel, rounds):
             def body(carry, _):
-                st, inbox = carry
-                st, outbox = self._step(
+                st, inbox, tel = carry
+                out = self._step(
                     st, inbox, ticks, self._zeros_b, props, self._zeros_b
                 )
-                return (st, route(cfg, outbox)), None
+                st, outbox = out[:2]
+                if cfg.telemetry:
+                    fr = out[-1]
+                    tel = (tel[0] + fr.counters, tel[1] | fr.invariants)
+                return (st, route(cfg, outbox), tel), None
 
-            (st, inbox), _ = jax.lax.scan(
-                body, (st, inbox), None, length=rounds
+            (st, inbox, tel), _ = jax.lax.scan(
+                body, (st, inbox, tel), None, length=rounds
             )
             # The scalar fence is a SEPARATE output buffer: pipelined
             # callers block on it to bound queue depth without holding
             # (and thereby breaking) a donated state buffer.
-            return st, inbox, st.commit[0]
+            return st, inbox, tel, st.commit[0]
 
         # State and inbox are donated: run_rounds/run_rounds_pipelined
         # reassign both from the return value, so XLA writes round k+1
         # into round k-1's freed SoA buffers instead of allocating.
+        # (The telemetry accumulator rides the carry undonated — it is
+        # tiny next to the SoA state and donation would complicate the
+        # telemetry-off path, which must stay byte-identical.)
         self._closed_loop = jax.jit(
             closed_loop, static_argnames=("rounds",), donate_argnums=(0, 1)
         )
@@ -87,11 +103,26 @@ class MultiRaftEngine:
         camp = campaign_mask if campaign_mask is not None else self._zeros_b
         props = propose_n if propose_n is not None else self._zeros_i
         iso = isolate if isolate is not None else self._zeros_b
-        self.state, outbox = self._step(
+        out = self._step(
             self.state, self.inbox, ticks, camp, props, iso,
             transfer_to, read_req,
         )
+        self.state, outbox = out[:2]
+        if self.cfg.telemetry:
+            fr = out[-1]
+            self._tel_counters = self._tel_counters + fr.counters
+            self._tel_invariants = self._tel_invariants | fr.invariants
         self.inbox = route(self.cfg, outbox)
+
+    def _tel(self):
+        """Telemetry carry for the closed loop (empty pytree when off)."""
+        if self.cfg.telemetry:
+            return (self._tel_counters, self._tel_invariants)
+        return ()
+
+    def _set_tel(self, tel) -> None:
+        if self.cfg.telemetry:
+            self._tel_counters, self._tel_invariants = tel
 
     def run_rounds(self, rounds: int, tick: bool = True,
                    propose_n: Optional[jnp.ndarray] = None) -> None:
@@ -99,9 +130,10 @@ class MultiRaftEngine:
         device (one fused lax.scan program)."""
         ticks = jnp.ones_like(self._zeros_b) if tick else self._zeros_b
         props = propose_n if propose_n is not None else self._zeros_i
-        self.state, self.inbox, _ = self._closed_loop(
-            self.state, self.inbox, ticks, props, rounds
+        self.state, self.inbox, tel, _ = self._closed_loop(
+            self.state, self.inbox, ticks, props, self._tel(), rounds
         )
+        self._set_tel(tel)
 
     def run_rounds_pipelined(self, rounds: int, chunk: int = 16,
                              depth: int = 2, tick: bool = True,
@@ -129,9 +161,10 @@ class MultiRaftEngine:
         done = 0
         while done < rounds:
             n = min(chunk, rounds - done)
-            self.state, self.inbox, fence = self._closed_loop(
-                self.state, self.inbox, ticks, props, n
+            self.state, self.inbox, tel, fence = self._closed_loop(
+                self.state, self.inbox, ticks, props, self._tel(), n
             )
+            self._set_tel(tel)
             done += n
             fences.append(fence)
             while len(fences) > depth:
@@ -185,6 +218,27 @@ class MultiRaftEngine:
             learner=st.learner.at[rows].set(lrn),
             in_joint=st.in_joint.at[rows].set(bool(joint)),
         )
+
+    # -- telemetry (device → host gather; cfg.telemetry only) -----------------
+
+    def telemetry(self) -> "tuple[np.ndarray, np.ndarray]":
+        """(counters [N, NUM_COUNTERS], invariants [N]) — monotone
+        per-instance totals accumulated in-device since the last reset
+        (column order: telemetry.TM_NAMES). One host gather; no
+        per-round sync ever happened."""
+        assert self.cfg.telemetry, "engine built with telemetry=False"
+        return (np.asarray(self._tel_counters),
+                np.asarray(self._tel_invariants))
+
+    def drain_telemetry(self, hub=None) -> "tuple[np.ndarray, np.ndarray]":
+        """Fold the accumulated totals into `hub` (or the attached
+        ``telemetry_hub``) via its monotone-totals path; returns the
+        fetched (counters, invariants)."""
+        counters, inv = self.telemetry()
+        hub = hub or self.telemetry_hub
+        if hub is not None:
+            hub.ingest_totals(counters, inv)
+        return counters, inv
 
     # -- observation (device → host gathers, debug/Ready watermarks) ----------
 

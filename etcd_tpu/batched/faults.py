@@ -317,6 +317,12 @@ class ChaosHarness:
             window=16, max_ents_per_msg=4, max_props_per_round=4,
             election_timeout=10, heartbeat_timeout=1,
             pre_vote=True, check_quorum=True, auto_compact=True,
+            # The default chaos config flies with the kernel telemetry
+            # plane on: the invariant sweep localizes device-side
+            # illegal states (the PR 2 progress wedge took manual
+            # instrumentation to even find) and a checker failure dumps
+            # every member's flight recorder.
+            telemetry=True,
         )
         self.transport = transport
         self.tick_interval = tick_interval
@@ -336,6 +342,7 @@ class ChaosHarness:
         # divergent one (holds a value never acked).
         self.acked: Dict[Tuple[int, bytes], bytes] = {}
         self.acked_history: Dict[Tuple[int, bytes], List[bytes]] = {}
+        self._retired_trips = 0  # trips banked from replaced members
         for mid in range(1, num_members + 1):
             self._boot(mid)
         for m in self.members.values():
@@ -344,6 +351,13 @@ class ChaosHarness:
     # -- membership ------------------------------------------------------------
 
     def _boot(self, mid: int) -> MultiRaftMember:
+        # A restart replaces the member object (and its telemetry
+        # hub): bank the outgoing hub's invariant trips first, or
+        # pre-crash illegal-progress evidence silently vanishes from
+        # the episode-close trips==0 assertion.
+        old = self.members.get(mid)
+        if old is not None and getattr(old, "hub", None) is not None:
+            self._retired_trips += old.hub.trips()
         m = MultiRaftMember(
             mid, self.r, self.g, self.data_dir, cfg=self.cfg,
             tick_interval=self.tick_interval, pipeline=self.pipeline,
@@ -526,6 +540,29 @@ class ChaosHarness:
                 acked += 1
         return acked
 
+    def dump_flight_recorders(self, reason: str = "chaos") -> List[str]:
+        """Dump every live member's telemetry flight recorder (no-op
+        when the config runs telemetry off); returns the paths."""
+        paths = []
+        for m in self.members.values():
+            hub = getattr(m, "hub", None)
+            if hub is not None:
+                try:
+                    paths.append(hub.dump(reason=reason))
+                except OSError:
+                    _log.exception("flight-recorder dump failed (m%d)",
+                                   m.id)
+        return paths
+
+    def invariant_trips(self) -> int:
+        """Total on-device invariant trips across members — including
+        members since replaced by a restart (0 when telemetry is off).
+        Episodes assert this stays 0."""
+        return self._retired_trips + sum(
+            m.hub.trips() for m in self.members.values()
+            if getattr(m, "hub", None) is not None
+        )
+
     def stop(self) -> None:
         self.fabric.stop()
         for m in self.members.values():
@@ -547,11 +584,23 @@ def run_invariant_checks(harness: ChaosHarness,
     durability assumption election safety rests on.
 
     ``allow_lag=1`` relaxes both state checkers to quorum agreement —
-    for episodes that can trip the known restarted-leader progress
-    wedge (a follower pinned one entry behind with probe_sent stuck;
-    see ROADMAP open items and tools/repro_progress_wedge.py). Safety
-    (quorum durability, no divergent values, election safety) is still
-    fully asserted; only all-member convergence is relaxed."""
+    for TORN-TAIL episodes, which tear fsync'd (possibly acked) bytes
+    and are therefore beyond raft's durability contract: a torn member
+    that wins an election with its shortened log can force a survivor
+    to overwrite an entry that survivor already COMMITTED AND APPLIED,
+    leaving its KV state divergent in a way no protocol can heal (the
+    reference has the same hole; root-caused here with the telemetry
+    flight recorder — the leader's match oscillates against the
+    survivor's below-commit fast-path ack at the conflicted commit
+    index). Safety within the contract (quorum durability, no
+    never-acked values, election safety) is still fully asserted.
+
+    When the harness flies with telemetry (the default config), the
+    closer also asserts the on-device invariant sweep stayed clean —
+    ZERO illegal-progress trips across every member and round. The
+    pre-fix progress wedge trips `next_le_match`/`probe_wedge`
+    persistently, so this is the regression tripwire for wedge-class
+    kernel bugs even under relaxed state checks."""
     # Lazy: the checkers module pulls in the server stack, which the
     # batched package must not import at module load.
     from ..functional.checker import (
@@ -564,11 +613,28 @@ def run_invariant_checks(harness: ChaosHarness,
     assert len(members) == expect_members, (
         f"{len(members)} members alive at episode close, "
         f"want {expect_members}")
-    multiraft_hash_check(members, timeout=hash_timeout,
-                         allow_lag=allow_lag)
-    committed_never_lost(members, harness.acked, timeout=acked_timeout,
-                         allow_lag=allow_lag,
-                         history=harness.acked_history)
-    if observer is not None:
-        observer.stop()
-        check_leader_claims(observer.conflicts)
+    try:
+        multiraft_hash_check(members, timeout=hash_timeout,
+                             allow_lag=allow_lag)
+        committed_never_lost(members, harness.acked,
+                             timeout=acked_timeout,
+                             allow_lag=allow_lag,
+                             history=harness.acked_history)
+        if observer is not None:
+            observer.stop()
+            check_leader_claims(observer.conflicts)
+        trips = harness.invariant_trips()
+        assert trips == 0, (
+            f"{trips} on-device invariant trips during the episode — "
+            "illegal kernel progress state (see the flight-recorder "
+            "dumps in artifacts/)")
+    except AssertionError:
+        # Checker failure: freeze the evidence. Every member's flight
+        # recorder (last K rounds of per-group kernel deltas + the
+        # invariant sweep) lands in artifacts/flightrec_*.json before
+        # the failure propagates.
+        paths = harness.dump_flight_recorders(reason="checker-failure")
+        if paths:
+            _log.error("chaos checker failed; flight recorders: %s",
+                       paths)
+        raise

@@ -45,6 +45,12 @@ from ..raft.types import Message, MessageType, Snapshot, SnapshotMetadata
 from .rawnode import BatchedRawNode, BatchedReady, RowRestore
 from .state import BatchedConfig, LEADER
 from .step import T_SNAP
+from .telemetry import (
+    TelemetryHub,
+    round_phase_histogram,
+    router_loss_counter,
+    wal_fsync_histogram,
+)
 
 
 from ..pkg.errors import NotLeaderError  # noqa: E402 — shared error type
@@ -210,6 +216,24 @@ class MultiRaftMember:
             self.cfg, groups=groups, slots=slots, restore=restore,
             mesh=mesh,
         )
+        # Telemetry plane (cfg.telemetry): the rawnode folds every
+        # round's kernel frame into this hub; WAL fsync latency and
+        # per-phase round timings land in the same registry. With
+        # telemetry off none of this is touched — the hot path is
+        # unchanged.
+        self.hub: Optional[TelemetryHub] = None
+        self._h_fsync = None
+        self._h_phase = None
+        if self.cfg.telemetry:
+            self.hub = TelemetryHub(num_groups, member=str(member_id))
+            self.rn.telemetry_hub = self.hub
+            mid = str(member_id)
+            self._h_fsync = wal_fsync_histogram().labels(mid)
+            ph = round_phase_histogram()
+            self._h_phase = {
+                p: ph.labels(mid, p) for p in ("round", "wal", "apply",
+                                               "send")
+            }
         if restore:
             for row, rr in restore.items():
                 self.applied_index[row] = rr.applied
@@ -280,6 +304,10 @@ class MultiRaftMember:
             rr.entries = [e for e in ents.get(g, []) if e[0] > si]
             lim = rr.snap_index + len(rr.entries)
             rr.commit = min(rr.commit, lim) if rr.commit else rr.commit
+            # BatchedRawNode._restore clamps commit up to snap_index (a
+            # persisted snapshot proves its index committed) — relevant
+            # here when a crash lands between the RT_SNAPSHOT record
+            # and the next hardstate record.
             restore[g] = rr
         return restore
 
@@ -371,7 +399,10 @@ class MultiRaftMember:
         rd = self.rn.advance_round()
         self.rn.advance()
         self.stats["rounds"] += 1
-        self.stats["round_s"] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats["round_s"] += dt
+        if self._h_phase is not None:
+            self._h_phase["round"].observe(dt)
         if self._drainer is not None:
             # Bounded: backpressure on the round — but never block
             # forever on a stopped/dead drain worker (see _drain_loop's
@@ -404,8 +435,14 @@ class MultiRaftMember:
                     self.wal.append(RT_ENTRY, _pack_entry(row, i, t, d, et))
                 must_sync |= rd.must_sync
             if must_sync:
+                tf = time.perf_counter()
                 self.wal.flush(sync=True)
-        self.stats["wal_s"] += time.perf_counter() - t0
+                if self._h_fsync is not None:
+                    self._h_fsync.observe(time.perf_counter() - tf)
+        dt = time.perf_counter() - t0
+        self.stats["wal_s"] += dt
+        if self._h_phase is not None:
+            self._h_phase["wal"].observe(dt)
         self.stats["batched"] += len(batch)
         fp(self._fp_after_save)  # crash-after-save-before-apply site
         for rd in batch:
@@ -463,6 +500,8 @@ class MultiRaftMember:
                 self._read_cv.notify_all()
         t1 = time.perf_counter()
         self.stats["apply_s"] += t1 - t0
+        if self._h_phase is not None:
+            self._h_phase["apply"].observe(t1 - t0)
         # 3b. send OUTSIDE the lock: delivery takes the receiver's lock,
         #     and two members sending to each other must not deadlock.
         if out and self._send is not None:
@@ -475,7 +514,10 @@ class MultiRaftMember:
                 from .msgblock import block_messages
 
                 self._send(self.id, block_messages(blk))
-        self.stats["send_s"] += time.perf_counter() - t1
+        dt = time.perf_counter() - t1
+        self.stats["send_s"] += dt
+        if self._h_phase is not None:
+            self._h_phase["send"].observe(dt)
 
     # -- wire ------------------------------------------------------------------
 
@@ -701,22 +743,36 @@ class InProcRouter:
         self.members: Dict[int, MultiRaftMember] = {}
         self._isolated: set = set()
         self._lock = threading.Lock()
-        # Per-member drop/error counters (ISSUE 2 satellite: a chaos
-        # run must be able to ASSERT that faults were exercised, and a
-        # production operator must see loss, not silence).
-        self._stats: Dict[int, Dict[str, int]] = {}
+        # Loss counters live on the shared pkg.metrics registry — ONE
+        # source of truth for drop classes across routers, fabrics and
+        # the telemetry plane (ISSUE 4 satellite). This router keeps
+        # per-(member, class) label children plus the child's value at
+        # first touch, so stats() still reports per-instance counts
+        # while /metrics exposes the process-wide monotone totals.
+        self._loss = router_loss_counter()
+        self._children: Dict[Tuple[int, str], Tuple[object, float]] = {}
 
     def _count(self, member_id: int, key: str, n: int = 1) -> None:
         with self._lock:
-            d = self._stats.setdefault(member_id, {})
-            d[key] = d.get(key, 0) + n
+            ent = self._children.get((member_id, key))
+            if ent is None:
+                child = self._loss.labels("inproc", str(member_id), key)
+                ent = (child, child.value())
+                self._children[(member_id, key)] = ent
+        ent[0].inc(n)
 
     def stats(self) -> Dict[int, Dict[str, int]]:
         """Per-member counters: isolated_drop (suppressed by
         isolate()), no_route (target not attached), deliver_error
-        (exception swallowed on the deliver path)."""
+        (exception swallowed on the deliver path). Values are read back
+        from the shared registry (etcd_tpu_router_loss_total), scoped
+        to this router instance."""
         with self._lock:
-            return {mid: dict(d) for mid, d in self._stats.items()}
+            items = list(self._children.items())
+        out: Dict[int, Dict[str, int]] = {}
+        for (mid, key), (child, base) in items:
+            out.setdefault(mid, {})[key] = int(child.value() - base)
+        return out
 
     def attach(self, m: MultiRaftMember) -> None:
         self.members[m.id] = m
@@ -835,7 +891,11 @@ class TCPRouter:
         # Fabric loss/error counters (never silently pass): queue-full
         # drops, oversize drops, dial failures, per-frame redial-budget
         # drops, send errors, corrupt inbound frames, deliver errors.
-        self._stats: Dict[str, int] = {}
+        # Counted on the shared registry (etcd_tpu_router_loss_total,
+        # transport="tcp") — same source of truth as InProcRouter;
+        # stats() reports this instance's deltas.
+        self._loss = router_loss_counter()
+        self._children: Dict[str, Tuple[object, float]] = {}
         self._stats_lock = threading.Lock()
         # peer id -> (queue, sender thread); established lazily.
         self._peers: Dict[int, "object"] = {}
@@ -855,14 +915,22 @@ class TCPRouter:
 
     def _count(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
-            self._stats[key] = self._stats.get(key, 0) + n
+            ent = self._children.get(key)
+            if ent is None:
+                child = self._loss.labels(
+                    "tcp", str(self.member.id), key)
+                ent = (child, child.value())
+                self._children[key] = ent
+        ent[0].inc(n)
 
     def stats(self) -> Dict[str, int]:
         """Loss/error counters for this member's fabric (the TCP analog
         of InProcRouter.stats); chaos tests assert these move, operators
-        read them through the admin 'stats' op."""
+        read them through the admin 'stats' op. Values read back from
+        the shared registry, scoped to this router instance."""
         with self._stats_lock:
-            return dict(self._stats)
+            items = list(self._children.items())
+        return {k: int(child.value() - base) for k, (child, base) in items}
 
     # -- outbound --------------------------------------------------------------
 

@@ -149,13 +149,32 @@ class AdminServer:
             # Loss/error observability (ISSUE 2 satellite): member
             # pipeline stats + the fabric's drop counters — queue-full
             # drops, dial failures, redial-budget drops, send errors —
-            # so operators see loss instead of silence.
+            # so operators see loss instead of silence. (The counters
+            # live on the shared metrics registry; see op 'metrics'
+            # for the full Prometheus-text dump.)
             rstats = {}
             rs = getattr(self.router, "stats", None)
             if callable(rs):
                 rstats = rs()
             return {"ok": True, "member": dict(m.stats),
                     "router": rstats}
+        if op == "metrics":
+            # Prometheus text exposition of the process registry —
+            # kernel telemetry counters, invariant trips, WAL fsync /
+            # round-phase histograms, router loss classes. Scrape with
+            # tools/dump_metrics.py --admin host:port.
+            from ..pkg import metrics as pmet
+
+            return {"ok": True, "text": pmet.DEFAULT.expose()}
+        if op == "flightrec":
+            # Dump the member's flight recorder (last K rounds of
+            # per-group telemetry deltas) to a JSON file on demand.
+            if m.hub is None:
+                return {"err": "telemetry disabled "
+                               "(BatchedConfig.telemetry=False)"}
+            path = m.hub.dump(reason=req.get("reason", "admin"))
+            return {"ok": True, "path": path,
+                    "trips": m.hub.trips()}
         if op == "bench":
             return self._bench(int(req["n"]),
                                int(req.get("value_size", 64)),
@@ -289,7 +308,8 @@ def serve(member_id: int, num_members: int, num_groups: int,
           admin: Tuple[str, int],
           peers: Dict[int, Tuple[str, int]],
           window: int = 32,
-          tick_interval: float = 0.1) -> None:
+          tick_interval: float = 0.1,
+          telemetry: bool = False) -> None:
     from .hosting import MultiRaftMember
     from .state import BatchedConfig
 
@@ -304,6 +324,9 @@ def serve(member_id: int, num_members: int, num_groups: int,
         pre_vote=True,
         check_quorum=True,
         auto_compact=True,
+        # --telemetry: kernel counters + invariant sweep + flight
+        # recorder, served through the admin 'metrics'/'flightrec' ops.
+        telemetry=telemetry,
     )
     member = MultiRaftMember(
         member_id, num_members, num_groups, data_dir, cfg=cfg,
@@ -333,6 +356,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="peerid=host:port (repeatable)")
     p.add_argument("--window", type=int, default=32)
     p.add_argument("--tick-interval", type=float, default=0.1)
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable the kernel telemetry plane (metrics + "
+                        "flight recorder via the admin API)")
     a = p.parse_args(argv)
 
     def hp(s: str) -> Tuple[str, int]:
@@ -345,7 +371,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         peers[int(pid)] = hp(addr)
     serve(a.id, a.members, a.groups, a.data_dir, hp(a.bind),
           hp(a.admin), peers, window=a.window,
-          tick_interval=a.tick_interval)
+          tick_interval=a.tick_interval, telemetry=a.telemetry)
 
 
 # -- client side ---------------------------------------------------------------

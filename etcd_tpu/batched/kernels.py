@@ -144,6 +144,51 @@ def find_conflict_by_term(
     return jnp.where(cnt > 0, snap_index + cnt, floor)
 
 
+def invariant_bits(st, slot) -> jnp.ndarray:
+    """Per-instance illegal-state bitmap (bit layout:
+    telemetry.INV_NAMES), computed on end-of-round state.
+
+    Everything here is impossible under the raft model — a set bit
+    means either a kernel bug or a violated environment assumption
+    (e.g. a torn WAL tail faking back acked state). Leader-side
+    progress conditions are masked to tracked peers other than self.
+    """
+    # Local constants mirror state.py (state imports nothing from this
+    # module, but keeping kernels import-free of state preserves the
+    # existing layering for its scalar-oracle consumers).
+    leader, probe, snapshot = 2, 0, 2
+    r = st.match.shape[-1]
+    peers = jnp.arange(r, dtype=I32)
+    is_leader = st.role == leader
+    tracked = (st.voter | st.voter_out | st.learner) & (peers != slot)
+    bad = [
+        # next <= match on a tracked peer: next must stay >= match+1.
+        is_leader & jnp.any(tracked & (st.next <= st.match)),
+        # commit beyond the last log index.
+        st.commit > st.last,
+        # compaction floor above the commit watermark.
+        st.snap_index > st.commit,
+        # a leader whose own lead pointer names someone else.
+        is_leader & (st.lead != slot + 1),
+        # the progress wedge signature: paused probe that can never
+        # make progress (probe_sent pinned while next <= match).
+        is_leader & jnp.any(
+            tracked & (st.pr_state == probe) & st.probe_sent
+            & (st.next <= st.match)),
+        # snapshot state whose pending index the peer already covers:
+        # the accept path can never lift the pause.
+        is_leader & jnp.any(
+            tracked & (st.pr_state == snapshot)
+            & (st.pending_snapshot <= st.match)),
+        # a confirmed read batch with no batch open.
+        st.read_ready & (st.read_index < 0),
+    ]
+    bits = jnp.zeros((), I32)
+    for i, b in enumerate(bad):
+        bits = bits | (b.astype(I32) << i)
+    return bits
+
+
 def ring_write(
     log_term: jnp.ndarray, start_index: jnp.ndarray, terms: jnp.ndarray,
     count: jnp.ndarray,

@@ -283,6 +283,12 @@ class BatchedRawNode:
             if os.environ.get("ETCD_TPU_PROF") else None
         )
 
+        # Telemetry plane (cfg.telemetry): the round returns an extra
+        # frame; advance_round fetches it with the other host reads and
+        # folds it into the attached hub (hosting layer sets one).
+        self.telemetry_hub = None  # TelemetryHub, optional
+        self.last_frame: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
     # -- boot ------------------------------------------------------------------
 
     def _restore(self, restore: Dict[int, RowRestore]) -> None:
@@ -299,7 +305,10 @@ class BatchedRawNode:
         for row, rr in restore.items():
             term[row] = rr.term
             vote[row] = rr.vote
-            commit[row] = rr.commit
+            # A snapshot at snap_index proves snap_index was committed;
+            # a stale persisted hardstate must not boot the row into
+            # the illegal watermark order commit < snap_index.
+            commit[row] = max(rr.commit, rr.snap_index)
             snap_i[row] = rr.snap_index
             snap_t[row] = rr.snap_term
             li = rr.snap_index
@@ -569,12 +578,14 @@ class BatchedRawNode:
                 send_append=st0.send_append.at[jnp.asarray(poke_rows)]
                 .set(True)
             )
-        st, outbox, aux = self._step(
+        step_out = self._step(
             self.state, inbox,
             self._dev(ticks), self._dev(camp),
             self._dev(props_n), self._dev(iso),
             self._dev(transfer), self._dev(read_req),
         )
+        st, outbox, aux = step_out[:3]
+        frame = step_out[3] if cfg.telemetry else None
         self.state = st
 
         # Device→host reads go through np.asarray, NOT jax.device_get:
@@ -595,6 +606,18 @@ class BatchedRawNode:
             )
         ]
         out_np = jax.tree.map(np.asarray, outbox)
+        if frame is not None:
+            # Same host gather as the state reads above — the counters
+            # were accumulated in-kernel; no extra sync happens here.
+            tel_counters = np.asarray(frame.counters)
+            tel_inv = np.asarray(frame.invariants)
+            self.last_frame = (tel_counters, tel_inv)
+            if self.telemetry_hub is not None:
+                from .telemetry import lane_summary
+
+                self.telemetry_hub.ingest_round(
+                    tel_counters, tel_inv,
+                    extra={"outbox_lanes": lane_summary(out_np.valid)})
         if prof is not None:
             t1 = time.perf_counter()
             prof["step"] += t1 - t0

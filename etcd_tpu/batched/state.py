@@ -76,6 +76,14 @@ class BatchedConfig(NamedTuple):
     # watermarks (term, commit, last, match, next, log_term ring) stay
     # int32 — narrowing those would change wrap semantics.
     narrow_lanes: bool = False
+    # Kernel telemetry plane (see batched/telemetry.py): the round
+    # emits one extra SoA output block — per-instance event counters
+    # plus an on-device invariant bitmap — accumulated in-kernel with
+    # no extra host sync. Static (compile-time): with telemetry=False
+    # the compiled round program is UNCHANGED (the telemetry code is
+    # never traced); with telemetry=True protocol state is
+    # bit-identical (the frame only reads state).
+    telemetry: bool = False
 
     @property
     def num_instances(self) -> int:

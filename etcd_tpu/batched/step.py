@@ -38,12 +38,14 @@ from .kernels import (
     VOTE_LOST,
     VOTE_WON,
     find_conflict_by_term,
+    invariant_bits,
     joint_committed,
     joint_vote_result,
     ring_write,
     ring_write_masked,
     term_at,
 )
+from .telemetry import NUM_COUNTERS
 from .state import (
     CANDIDATE,
     FOLLOWER,
@@ -610,8 +612,22 @@ def _leader_app_resp(cfg: BatchedConfig, st: BatchedState, m: MsgSlots, s):
     )
     # On a genuine rejection a replicating peer drops to probing
     # (becomeProbe: next=match+1, reset probe bookkeeping).
+    #
+    # Stale-high match repair: a follower that rejects the probe at
+    # next-1 with a hint BELOW our recorded match has verifiably lost
+    # entries it once acked — reachable only when durability was
+    # violated under it (torn WAL tail). The reference keeps match
+    # untouched (its Next >= Match+1 invariant makes this state
+    # unreachable in-model), but keeping it here pins next <= match and
+    # the accept path then drops every re-ack at-or-below match
+    # (`updated` false) — the restarted-member progress wedge: next
+    # frozen, the missing suffix never re-sent. Lowering match is
+    # always safe (commit is monotone and never re-derived), so take
+    # the follower's own evidence and let normal probing re-heal.
+    match_repair = at_s & (dec_next <= match_s)
     st_rej = st._replace(
         next=jnp.where(at_s, dec_next, st.next),
+        match=jnp.where(match_repair, dec_next - 1, st.match),
         probe_sent=jnp.where(at_s, False, st.probe_sent),
         pr_state=jnp.where(at_s & in_repl, PROBE, st.pr_state),
         pending_snapshot=jnp.where(at_s & in_repl, 0, st.pending_snapshot),
@@ -1119,6 +1135,54 @@ def route(cfg: BatchedConfig, outbox: MsgSlots) -> MsgSlots:
     return inbox
 
 
+class TelemetryFrame(NamedTuple):
+    """Per-round kernel telemetry (cfg.telemetry): event counters in
+    telemetry.TM_NAMES column order plus the on-device invariant
+    bitmap (kernels.invariant_bits / telemetry.INV_NAMES)."""
+
+    counters: jnp.ndarray  # [N, NUM_COUNTERS] i32 (per-instance [C])
+    invariants: jnp.ndarray  # [N] i32 bitmap (per-instance scalar)
+
+
+def _telemetry_frame(cfg: BatchedConfig, slot, pre: BatchedState,
+                     post: BatchedState, inbox_i: MsgSlots,
+                     out: MsgSlots, last_tick, n_new) -> TelemetryFrame:
+    """Counters for one instance's round — a pure READ of the round's
+    inputs/outputs (column order = telemetry.TM_NAMES). Never touches
+    protocol state, so telemetry=True stays bit-identical."""
+    cnt = lambda m: jnp.sum(m.astype(I32))  # noqa: E731
+    v, t = out.valid, out.type
+    ar_v = v[:, KIND_APP_RESP] & (t[:, KIND_APP_RESP] == T_APP_RESP)
+    appended = post.last - last_tick
+    cand = lambda role: (role == CANDIDATE) | (role == PRECANDIDATE)  # noqa: E731
+    won = (post.role == LEADER) & (pre.role != LEADER)
+    started = (cand(post.role) & ~cand(pre.role)) | (won & ~cand(pre.role))
+    cols = (
+        cnt(v[:, KIND_VOTE]),
+        cnt(v[:, KIND_APP] & (t[:, KIND_APP] == T_APP)),
+        cnt(v[:, KIND_APP] & (t[:, KIND_APP] == T_SNAP)),
+        cnt(v[:, KIND_HB] & (t[:, KIND_HB] == T_HB)),
+        cnt(v[:, KIND_HB] & (t[:, KIND_HB] == T_TIMEOUT_NOW)),
+        cnt(v[:, KIND_VOTE_RESP]),
+        cnt(v[:, KIND_APP_RESP]),
+        cnt(v[:, KIND_HB_RESP]),
+        cnt(inbox_i.valid),
+        cnt(ar_v & ~out.reject[:, KIND_APP_RESP]),
+        cnt(ar_v & out.reject[:, KIND_APP_RESP]),
+        cnt((pre.pr_state == PROBE) & (post.pr_state == REPLICATE)),
+        cnt((pre.pr_state != SNAPSHOT) & (post.pr_state == SNAPSHOT)),
+        cnt((pre.pr_state != PROBE) & (post.pr_state == PROBE)),
+        started.astype(I32),
+        won.astype(I32),
+        post.commit - pre.commit,
+        (post.read_ready & ~pre.read_ready).astype(I32),
+        jnp.maximum(jnp.maximum(n_new, 0) - appended, 0),
+    )
+    counters = jnp.stack([jnp.asarray(c, I32) for c in cols])
+    assert counters.shape == (NUM_COUNTERS,)
+    return TelemetryFrame(counters, invariant_bits(post, slot))
+
+
 class StepAux(NamedTuple):
     """Per-instance mid-round snapshots the host needs.
 
@@ -1160,6 +1224,7 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
             # Phases carry jax.named_scope annotations so xprof/JAX
             # profiler traces attribute device time per phase (SURVEY
             # §5 tracing: profiler hooks around the step kernel).
+            pre = sti  # round-entry state (telemetry deltas)
             inbox_i = inbox_i._replace(valid=inbox_i.valid & ~iso)
             with jax.named_scope("raft_deliver"):
                 sti, req_resps = _deliver_all(cfg, iid, slot, sti, inbox_i)
@@ -1179,7 +1244,13 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
                 lambda o, rr: o.at[:, 3:].set(rr), out, req_resps
             )
             out = out._replace(valid=out.valid & ~iso)
-            return sti, out, StepAux(last_tick, *read_snap)
+            ret = (sti, out, StepAux(last_tick, *read_snap))
+            if cfg.telemetry:
+                with jax.named_scope("raft_telemetry"):
+                    ret += (_telemetry_frame(
+                        cfg, slot, pre, sti, inbox_i, out, last_tick,
+                        n_new),)
+            return ret
 
         if cfg.lanes_minor:
             # Instance axis minor inside the kernel: every elementwise
@@ -1195,20 +1266,22 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
                 (iids, slots, st, inbox, tick_mask, campaign_mask,
                  propose_n, isolate, transfer_to, read_req),
             )
-            sti, out, aux = jax.vmap(
+            outs = jax.vmap(
                 per_instance, in_axes=-1, out_axes=-1
             )(*args)
-            sti, out, aux = jax.tree.map(to_major, (sti, out, aux))
+            outs = jax.tree.map(to_major, outs)
         else:
-            sti, out, aux = jax.vmap(per_instance)(
+            outs = jax.vmap(per_instance)(
                 iids, slots, st, inbox, tick_mask, campaign_mask,
                 propose_n, isolate, transfer_to, read_req,
             )
+        sti, out, aux = outs[:3]
         if cfg.narrow_lanes:
             sti = narrow_state(sti)
-        if with_aux:
-            return sti, out, aux
-        return sti, out
+        ret = (sti, out) + ((aux,) if with_aux else ())
+        if cfg.telemetry:
+            ret += (outs[3],)
+        return ret
 
     # NOT donated: hosting callers (BatchedRawNode) build the inbox by
     # zero-copy wrapping host numpy staging buffers (jnp.asarray on CPU

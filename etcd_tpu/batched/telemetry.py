@@ -1,0 +1,342 @@
+"""Device-to-host telemetry plane for the batched multi-raft engine.
+
+The jitted round is a black box by construction — every observable
+worth having (who voted, who probed, who stalled) lives in device
+arrays the host never looks at on the hot path. This module is the
+observability spine the SURVEY maps as etcd's Status/metrics plane
+("device -> host gather"), in the Dapper spirit of always-on,
+low-overhead tracing:
+
+* **Kernel counters** — behind ``BatchedConfig.telemetry`` (default
+  off), ``step.py`` emits one extra SoA block per round
+  (``TelemetryFrame``): per-instance event counters (messages emitted
+  by lane/type, append accepts/rejects, progress-state transitions,
+  elections started/won, commit delta, ReadIndex confirmations,
+  proposals dropped) plus an **invariant bitmap** computed on-device
+  (``kernels.invariant_bits``). The frame is a pure function of round
+  inputs/outputs: with telemetry off the compiled program is
+  unchanged; with it on, protocol state stays bit-identical.
+
+* **Host hub** — ``TelemetryHub`` folds round frames into monotonic
+  counters on the shared ``pkg.metrics`` registry (labeled by member /
+  group-shard) and keeps a bounded **flight recorder**: a ring of the
+  last K rounds of per-group deltas plus inbox/outbox lane summaries,
+  dumped to ``artifacts/flightrec_*.json`` on demand, on invariant
+  trip, or on chaos-checker failure.
+
+This module is import-light on purpose (numpy + pkg.metrics, no jax):
+``step.py`` imports the counter indices from here; the hub side never
+touches device code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..pkg import metrics as pmet
+
+# -----------------------------------------------------------------------------
+# Counter layout (column order of TelemetryFrame.counters; step.py
+# builds the frame in exactly this order — keep the two in sync).
+# -----------------------------------------------------------------------------
+
+TM_NAMES = (
+    "sent_vote_req",       # vote / pre-vote requests emitted
+    "sent_append",         # MsgApp emitted (probes included)
+    "sent_snapshot",       # MsgSnap emitted
+    "sent_heartbeat",      # MsgHeartbeat emitted
+    "sent_timeout_now",    # MsgTimeoutNow emitted (leader transfer)
+    "sent_vote_resp",      # vote / pre-vote responses emitted
+    "sent_append_resp",    # MsgAppResp emitted (accepts + rejects)
+    "sent_heartbeat_resp",  # MsgHeartbeatResp emitted
+    "recv_messages",       # inbox slots delivered (post-isolation)
+    "append_accepted",     # inbound appends acked (reject=false)
+    "append_rejected",     # inbound appends rejected (hint probing)
+    "probe_to_replicate",  # peer transitions PROBE -> REPLICATE
+    "to_snapshot",         # peer transitions into SNAPSHOT
+    "to_probe",            # peer transitions into PROBE
+    "elections_started",   # campaigns entered (candidate/pre-candidate)
+    "elections_won",       # transitions into LEADER
+    "commit_delta",        # commit-index advance this round
+    "reads_confirmed",     # ReadIndex batches quorum-confirmed
+    "proposals_dropped",   # staged proposals the device did not append
+)
+NUM_COUNTERS = len(TM_NAMES)
+TM_INDEX = {n: i for i, n in enumerate(TM_NAMES)}
+
+# Invariant bitmap layout (kernels.invariant_bits builds bits in this
+# order). Every bit is impossible under the raft model: a trip means a
+# kernel bug or a violated environment assumption (torn WAL tail).
+INV_NAMES = (
+    "next_le_match",        # progress next <= match on a tracked peer
+    "commit_gt_last",       # commit beyond the last log index
+    "snap_gt_commit",       # compaction floor above commit
+    "leader_lead_mismatch",  # leader whose lead pointer names another
+    "probe_wedge",          # paused probe with next <= match (the
+    # restarted-member wedge signature — see CHANGES.md PR 4)
+    "snapshot_stuck",       # SNAPSHOT state with pending <= match
+    "read_ready_no_batch",  # confirmed read with no batch open
+)
+
+
+def decode_invariants(bits: int) -> List[str]:
+    return [n for i, n in enumerate(INV_NAMES) if bits & (1 << i)]
+
+
+# -----------------------------------------------------------------------------
+# Registry metric families (registered lazily, shared process-wide;
+# label children distinguish members/shards).
+# -----------------------------------------------------------------------------
+
+
+def counter_family(name: str,
+                   registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        f"etcd_tpu_batched_{name}_total",
+        f"batched kernel telemetry: {name} events",
+        ("member", "shard"),
+    ))
+
+
+def invariant_family(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_batched_invariant_trips_total",
+        "on-device invariant bitmap trips (any set bit is a bug or a "
+        "violated durability assumption)",
+        ("member", "invariant"),
+    ))
+
+
+def wal_fsync_histogram(
+        registry: Optional[pmet.Registry] = None) -> pmet.Histogram:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Histogram(
+        "etcd_tpu_hosting_wal_fsync_seconds",
+        "WAL append+fsync latency per persistence batch",
+        ("member",),
+    ))
+
+
+def round_phase_histogram(
+        registry: Optional[pmet.Registry] = None) -> pmet.Histogram:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Histogram(
+        "etcd_tpu_hosting_round_phase_seconds",
+        "member pipeline phase wall time per round "
+        "(phase: round/wal/apply/send)",
+        ("member", "phase"),
+        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0),
+    ))
+
+
+def router_loss_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    """One source of truth for transport drop classes (InProcRouter and
+    TCPRouter both count here; their stats() ops read back from it)."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_router_loss_total",
+        "messages lost or errored by the member fabric, by drop class",
+        ("transport", "member", "cls"),
+    ))
+
+
+# -----------------------------------------------------------------------------
+# The hub
+# -----------------------------------------------------------------------------
+
+
+class TelemetryHub:
+    """Folds per-round telemetry frames into the metrics registry and
+    keeps a bounded flight recorder.
+
+    ``n_rows``: instance rows of the attached engine/rawnode (groups
+    for a hosting member). Counters are exposed summed per group-shard
+    (``shards`` label children per member — per-group label children
+    would explode at G=65536). The flight recorder keeps per-row
+    detail: full per-row deltas when ``n_rows`` is small, else totals
+    plus the rows whose invariants tripped.
+    """
+
+    # Keep full per-row counter deltas in the ring below this many rows.
+    FULL_DETAIL_ROWS = 256
+
+    def __init__(self, n_rows: int, member: str = "0",
+                 registry: Optional[pmet.Registry] = None,
+                 ring: int = 64, shards: int = 8,
+                 dump_dir: Optional[str] = None,
+                 dump_on_trip: bool = True) -> None:
+        self.n_rows = int(n_rows)
+        self.member = str(member)
+        self.registry = registry or pmet.DEFAULT
+        self.shards = max(1, min(int(shards), self.n_rows))
+        self._shard_of = (
+            np.arange(self.n_rows) * self.shards // max(self.n_rows, 1)
+        )
+        self.dump_dir = dump_dir or os.environ.get(
+            "ETCD_TPU_FLIGHTREC_DIR", "artifacts")
+        self.dump_on_trip = dump_on_trip
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(ring))
+        self._round = 0
+        self._trips = 0
+        self._dumped_on_trip = False
+        self._last_totals: Optional[np.ndarray] = None
+        self._last_inv: Optional[np.ndarray] = None
+        self._counters = [
+            [counter_family(n, self.registry).labels(self.member, str(s))
+             for s in range(self.shards)]
+            for n in TM_NAMES
+        ]
+        self._inv_counter = invariant_family(self.registry)
+        self.last_dump: Optional[str] = None
+
+    # -- ingest ---------------------------------------------------------------
+
+    def ingest_round(self, counters: np.ndarray, invariants: np.ndarray,
+                     extra: Optional[Dict] = None) -> None:
+        """Fold one round's frame: ``counters`` [n_rows, NUM_COUNTERS]
+        per-round deltas, ``invariants`` [n_rows] bitmaps."""
+        counters = np.asarray(counters)
+        invariants = np.asarray(invariants)
+        # Registry fold: per counter, per shard.
+        for ci in range(NUM_COUNTERS):
+            col = counters[:, ci]
+            if not col.any():
+                continue
+            if self.shards == 1:
+                self._counters[ci][0].inc(float(col.sum()))
+            else:
+                sums = np.bincount(self._shard_of, weights=col,
+                                   minlength=self.shards)
+                for s in np.nonzero(sums)[0]:
+                    self._counters[ci][int(s)].inc(float(sums[s]))
+        tripped = np.nonzero(invariants)[0]
+        for row in tripped:
+            for name in decode_invariants(int(invariants[row])):
+                self._inv_counter.labels(self.member, name).inc()
+        with self._lock:
+            self._round += 1
+            self._ring.append(self._record(counters, invariants,
+                                           tripped, extra))
+            self._trips += len(tripped)
+            want_dump = (
+                len(tripped) > 0 and self.dump_on_trip
+                and not self._dumped_on_trip
+            )
+            if want_dump:
+                self._dumped_on_trip = True
+        if want_dump:
+            try:
+                self.dump(reason="invariant-trip")
+            except OSError:
+                # The dump is evidence, not control flow: an unwritable
+                # dump dir must not take down the member round thread
+                # that ingested the frame.
+                pass
+
+    def ingest_totals(self, counters: np.ndarray, invariants: np.ndarray,
+                      extra: Optional[Dict] = None) -> None:
+        """Fold MONOTONE totals (the engine's in-device accumulator):
+        the delta against the previously ingested totals is fed through
+        ``ingest_round``. The invariant bitmap is OR-folded on device,
+        so only bits NEWLY set since the last drain count — draining
+        every chunk must not re-count one trip per drain. Used by
+        closed-loop callers that only sync at chunk boundaries."""
+        counters = np.asarray(counters, np.int64)
+        invariants = np.asarray(invariants, np.int64)
+        with self._lock:
+            prev = self._last_totals
+            prev_inv = self._last_inv
+            self._last_totals = counters.copy()
+            self._last_inv = invariants.copy()
+        delta = counters if prev is None else counters - prev
+        new_inv = (invariants if prev_inv is None
+                   else invariants & ~prev_inv)
+        self.ingest_round(np.maximum(delta, 0), new_inv, extra)
+
+    def _record(self, counters: np.ndarray, invariants: np.ndarray,
+                tripped: np.ndarray, extra: Optional[Dict]) -> Dict:
+        rec: Dict = {
+            "round": self._round,
+            "t": time.time(),
+            "totals": {
+                n: int(counters[:, i].sum())
+                for i, n in enumerate(TM_NAMES) if counters[:, i].any()
+            },
+        }
+        if self.n_rows <= self.FULL_DETAIL_ROWS:
+            nz_rows = np.nonzero(counters.any(axis=1))[0]
+            rec["rows"] = {
+                int(r): {
+                    n: int(counters[r, i])
+                    for i, n in enumerate(TM_NAMES) if counters[r, i]
+                }
+                for r in nz_rows
+            }
+        if len(tripped):
+            rec["invariants"] = {
+                int(r): decode_invariants(int(invariants[r]))
+                for r in tripped
+            }
+        if extra:
+            rec["extra"] = extra
+        return rec
+
+    # -- flight recorder ------------------------------------------------------
+
+    def records(self) -> List[Dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def trips(self) -> int:
+        with self._lock:
+            return self._trips
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual") -> str:
+        """Write the flight-recorder ring (+ a registry snapshot of this
+        member's counters) as JSON; returns the path."""
+        with self._lock:
+            recs = list(self._ring)
+            rnd = self._round
+            trips = self._trips
+        if path is None:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            ts = time.strftime("%Y%m%d-%H%M%S")
+            path = os.path.join(
+                self.dump_dir,
+                f"flightrec_m{self.member}_{ts}_{reason}.json")
+        payload = {
+            "member": self.member,
+            "reason": reason,
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "rounds_ingested": rnd,
+            "invariant_trips": trips,
+            "counter_names": list(TM_NAMES),
+            "invariant_names": list(INV_NAMES),
+            "ring": recs,
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        with self._lock:
+            self.last_dump = path
+        return path
+
+
+def lane_summary(valid: np.ndarray) -> List[int]:
+    """Per-lane message counts from a [n, R, K] validity mask — the
+    decoded inbox/outbox summary the flight recorder rides."""
+    return np.asarray(valid).sum(axis=(0, 1)).astype(int).tolist()

@@ -17,11 +17,17 @@ from typing import Tuple
 
 
 def make_bench_engine(groups: int, lanes_minor: bool = True,
-                      merged_deliver: bool = False):
+                      merged_deliver: bool = False,
+                      telemetry: bool = False):
     """Build the canonical bench engine (BENCH_r05 methodology: R=3,
     W=32, E=4, steady state with no timer elections, auto-compacting
     ring), elect every group's slot-0 replica, and return the engine
-    plus the steady 2-entries-per-group-per-round proposal vector."""
+    plus the steady 2-entries-per-group-per-round proposal vector.
+
+    ``telemetry`` compiles the kernel telemetry plane in (ISSUE 4):
+    the headline number stays telemetry-off; BENCH_TELEMETRY=1 /
+    frontier --telemetry measure the overhead so it stays pinned in
+    BENCH_NOTES."""
     import jax.numpy as jnp
 
     from ..batched import BatchedConfig, MultiRaftEngine
@@ -37,6 +43,7 @@ def make_bench_engine(groups: int, lanes_minor: bool = True,
         auto_compact=True,  # sustained load: ring chases the applied mark
         lanes_minor=lanes_minor,
         merged_deliver=merged_deliver,
+        telemetry=telemetry,
     )
     eng = MultiRaftEngine(cfg)
     eng.campaign([g * cfg.num_replicas for g in range(groups)])

@@ -1,17 +1,23 @@
 """Metrics dumper (ref: tools/etcd-dump-metrics — spawn or scrape a
-member and print its metric names/values sorted)."""
+member and print its metric names/values sorted).
+
+Three sources: an HTTP /metrics endpoint (--addr), a batched hosting
+member's admin port (--admin, the line-JSON 'metrics' op serving the
+same Prometheus text — kernel telemetry counters, invariant trips,
+WAL fsync / round-phase histograms, router loss classes), or the local
+registry (default: every metric this build registers)."""
 
 from __future__ import annotations
 
 import argparse
+import json
+import socket
 import sys
 import urllib.request
 from typing import List, Optional
 
 
-def dump_url(url: str, names_only: bool = False) -> int:
-    with urllib.request.urlopen(url, timeout=10) as r:
-        text = r.read().decode()
+def _print_text(text: str, names_only: bool) -> int:
     for line in sorted(text.splitlines()):
         if line.startswith("#"):
             continue
@@ -19,16 +25,45 @@ def dump_url(url: str, names_only: bool = False) -> int:
     return 0
 
 
+def dump_url(url: str, names_only: bool = False) -> int:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        text = r.read().decode()
+    return _print_text(text, names_only)
+
+
+def dump_admin(addr: str, names_only: bool = False) -> int:
+    """Scrape a hosting member's admin endpoint (hosting_proc
+    AdminServer, op 'metrics')."""
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=10) as s:
+        f = s.makefile("rwb")
+        f.write(json.dumps({"op": "metrics"}).encode() + b"\n")
+        f.flush()
+        resp = json.loads(f.readline())
+    if not resp.get("ok"):
+        print(f"admin metrics failed: {resp}", file=sys.stderr)
+        return 1
+    return _print_text(resp["text"], names_only)
+
+
 def dump_local(names_only: bool = False) -> int:
     """Every metric this build registers (spawns nothing: importing the
-    server modules registers the full set)."""
+    server modules registers the full set; the batched telemetry
+    families register explicitly — they are otherwise lazy)."""
     import etcd_tpu.server.metrics  # noqa: F401
     import etcd_tpu.server.server  # noqa: F401
     import etcd_tpu.storage.metrics  # noqa: F401
     import etcd_tpu.storage.mvcc.metrics  # noqa: F401
     import etcd_tpu.transport.metrics  # noqa: F401
+    from etcd_tpu.batched import telemetry as btel
     from etcd_tpu.pkg import metrics as pmet
 
+    for name in btel.TM_NAMES:
+        btel.counter_family(name)
+    btel.invariant_family()
+    btel.wal_fsync_histogram()
+    btel.round_phase_histogram()
+    btel.router_loss_counter()
     for line in pmet.DEFAULT.expose().splitlines():
         if line.startswith("#"):
             continue
@@ -40,8 +75,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="etcd-dump-metrics")
     p.add_argument("--addr", default="",
                    help="scrape http://addr/metrics instead of local defaults")
+    p.add_argument("--admin", default="",
+                   help="scrape a batched hosting member's admin port "
+                        "(host:port, hosting_proc 'metrics' op)")
     p.add_argument("--names-only", action="store_true")
     args = p.parse_args(argv)
+    if args.admin:
+        return dump_admin(args.admin, args.names_only)
     if args.addr:
         url = args.addr
         if not url.startswith("http"):

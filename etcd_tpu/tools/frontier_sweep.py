@@ -61,13 +61,14 @@ def _log(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
-def _make_engine(groups: int, merged: bool):
+def _make_engine(groups: int, merged: bool, telemetry: bool = False):
     # The bench.py config and setup (BENCH_r05 methodology), from the
     # shared module so the sweep cannot desynchronize from bench.py.
     from .benchlib import make_bench_engine
 
     return make_bench_engine(groups, lanes_minor=True,
-                             merged_deliver=merged)
+                             merged_deliver=merged,
+                             telemetry=telemetry)
 
 
 def _pipeline_gate(merged: bool) -> None:
@@ -91,11 +92,11 @@ def _pipeline_gate(merged: bool) -> None:
 
 
 def _measure_point(groups: int, merged: bool, rounds_per_call: int,
-                   calls: int) -> dict:
+                   calls: int, telemetry: bool = False) -> dict:
     from .benchlib import measure_commit_p50, measure_rate
 
     t0 = time.perf_counter()
-    eng, props = _make_engine(groups, merged)
+    eng, props = _make_engine(groups, merged, telemetry)
     build_s = time.perf_counter() - t0
     _log(f"G={groups}: built+compiled in {build_s:.1f}s")
 
@@ -173,6 +174,9 @@ def main() -> None:
     ap.add_argument("--calls", type=int, default=8)
     ap.add_argument("--merged", action="store_true",
                     help="merged request/response deliver scans")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="compile the kernel telemetry plane into the "
+                         "measured round (overhead sweep; ISSUE 4)")
     ap.add_argument("--skip-gate", action="store_true")
     ap.add_argument("--skip-warm-check", action="store_true")
     ap.add_argument("--append-notes", default="",
@@ -212,6 +216,7 @@ def main() -> None:
         "loop": "pipelined (run_rounds_pipelined chunk=%d depth=2)"
                 % args.rounds_per_call,
         "deliver": "merged" if merged else "six",
+        "telemetry": bool(args.telemetry),
         "compile_cache": cache_dir or "disabled",
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "captured_by": "tools/frontier_sweep.py",
@@ -228,7 +233,7 @@ def main() -> None:
         try:
             result["points"].append(
                 _measure_point(g, merged, args.rounds_per_call,
-                               args.calls))
+                               args.calls, args.telemetry))
         except Exception as e:  # noqa: BLE001 — record partial frontier
             _log(f"G={g} failed: {e!r}; frontier stays partial")
             result.setdefault("failed", []).append(

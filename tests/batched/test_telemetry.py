@@ -1,0 +1,251 @@
+"""Kernel telemetry plane (ISSUE 4): bit-parity, invariant sweep,
+flight recorder, counter accuracy against the shadow oracle.
+
+Tier-1 subset on tiny configs (G=2, R=3, W=32) — the heavyweight
+soak/recorder coverage rides the slow-marked chaos suites. All tests
+share TWO BatchedConfigs (telemetry on/off) so the jitted round
+compiles once each per pytest process, and the pipelined pass reuses
+the serial pass's scan program (same static round count).
+"""
+
+import glob
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
+from etcd_tpu.batched.shadow import ShadowCluster
+from etcd_tpu.batched.telemetry import (
+    INV_NAMES,
+    NUM_COUNTERS,
+    TM_INDEX,
+    TM_NAMES,
+    TelemetryHub,
+    decode_invariants,
+)
+from etcd_tpu.pkg import metrics as pmet
+
+G, R = 2, 3
+ET = 1 << 20  # no timer elections: deterministic schedules
+
+
+def make_cfg(telemetry):
+    return BatchedConfig(
+        num_groups=G, num_replicas=R, window=32,
+        max_ents_per_msg=4, max_props_per_round=4,
+        election_timeout=ET, heartbeat_timeout=1,
+        telemetry=telemetry,
+    )
+
+
+CFG_OFF = make_cfg(False)
+CFG_ON = make_cfg(True)
+
+
+def drive(eng, pipelined):
+    """One fixed schedule: elections, proposals, heartbeats, and a
+    ReadIndex batch — the same input stream for on/off engines. The
+    pipelined variant uses chunk == rounds so it runs the exact scan
+    program the serial variant compiled."""
+    n = eng.cfg.num_instances
+    eng.campaign([i * R for i in range(G)])
+    for _ in range(3):
+        eng.step_round()
+    props = jnp.zeros((n,), jnp.int32)
+    props = props.at[jnp.arange(G) * R].set(2)
+    eng.step_round(propose_n=props)
+    eng.read_index([0])
+    if pipelined:
+        eng.run_rounds_pipelined(12, chunk=12, tick=True,
+                                 propose_n=props)
+    else:
+        eng.run_rounds(12, tick=True, propose_n=props)
+    eng.step_round(tick=True)
+
+
+def test_protocol_state_bit_identical_on_off():
+    """Acceptance: telemetry=True must not change a single bit of
+    protocol state vs telemetry=False, on both the serial and the
+    pipelined round loops. One engine pair runs both phases back to
+    back (the pipelined chunk reuses the serial phase's compiled scan
+    program), comparing full state + inbox after each."""
+    a = MultiRaftEngine(CFG_OFF)
+    b = MultiRaftEngine(CFG_ON)
+
+    def compare(loop):
+        for field in a.state._fields:
+            av = np.asarray(getattr(a.state, field))
+            bv = np.asarray(getattr(b.state, field))
+            assert np.array_equal(av, bv), (
+                f"state field {field} diverged with telemetry on "
+                f"({loop})")
+        for field in a.inbox._fields:
+            av = np.asarray(getattr(a.inbox, field))
+            bv = np.asarray(getattr(b.inbox, field))
+            assert np.array_equal(av, bv), (
+                f"inbox field {field} diverged ({loop})")
+
+    drive(a, False)
+    drive(b, False)
+    compare("serial")
+    drive(a, True)
+    drive(b, True)
+    compare("pipelined")
+
+
+def test_injected_illegal_progress_trips_invariants_and_dumps(tmp_path):
+    """Acceptance: an injected illegal-progress state (the wedge
+    signature: next <= match with probe_sent pinned) trips the
+    on-device invariant bitmap, and the hub emits a flight-recorder
+    dump on the first trip."""
+    eng = MultiRaftEngine(CFG_ON)
+    eng.campaign([0])
+    for _ in range(3):
+        eng.step_round()
+    assert eng.leaders()[0] == 0
+    # Surgery on the leader row: pin peer 1's progress into the
+    # illegal state next == match, PROBE, probe_sent.
+    st = eng.state
+    m = int(np.asarray(st.match[0, 1]))
+    eng.state = st._replace(
+        next=st.next.at[0, 1].set(max(m, 1)),
+        match=st.match.at[0, 1].set(max(m, 1)),
+        probe_sent=st.probe_sent.at[0, 1].set(True),
+    )
+    eng.step_round()
+    _counters, inv = eng.telemetry()
+    names = decode_invariants(int(inv[0]))
+    assert "next_le_match" in names, names
+    assert "probe_wedge" in names, names
+
+    reg = pmet.Registry()
+    hub = TelemetryHub(eng.cfg.num_instances, member="9", registry=reg,
+                       dump_dir=str(tmp_path))
+    eng.drain_telemetry(hub)
+    assert hub.trips() >= 1
+    dumps = glob.glob(str(tmp_path / "flightrec_m9_*invariant-trip.json"))
+    assert dumps, "no flight-recorder dump on invariant trip"
+    rec = json.loads(open(dumps[0]).read())
+    assert rec["invariant_names"] == list(INV_NAMES)
+    ring = rec["ring"]
+    tripped = next(r for r in ring if "invariants" in r)
+    assert "next_le_match" in tripped["invariants"]["0"]
+    # The registry carries the trip counter too.
+    text = reg.expose()
+    assert 'invariant="next_le_match"' in text
+
+
+def test_counters_reconcile_with_shadow_oracle():
+    """Acceptance: elections-won and commit-delta totals must match the
+    oracle's event log for a lockstep schedule; message counters must
+    match the oracle's emitted-message log."""
+    eng = MultiRaftEngine(CFG_ON)
+    shadows = [ShadowCluster(R, election_timeout=ET, heartbeat_timeout=1)
+               for _ in range(G)]
+
+    schedule = (
+        [{"campaign": {(0, 0): True, (1, 2): True}}]
+        + [{} for _ in range(4)]
+        + [{"propose": {(0, 0): 2, (1, 2): 1}}]
+        + [{} for _ in range(3)]
+        + [{"propose": {(0, 0): 3}}]
+        + [{} for _ in range(3)]
+        + [{"tick": True}]
+        + [{} for _ in range(3)]
+    )
+
+    n = eng.cfg.num_instances
+    oracle_won = 0
+    oracle_commit = 0
+    oracle_sent = 0
+    prev_roles = [[int(s.nodes[i].raft.state) for i in range(R)]
+                  for s in shadows]
+    prev_commit = [[s.nodes[i].raft.raft_log.committed for i in range(R)]
+                   for s in shadows]
+    LEADER = 2
+    for step in schedule:
+        camp = np.zeros(n, bool)
+        props = np.zeros(n, np.int32)
+        for (gi, s) in step.get("campaign", {}):
+            camp[gi * R + s] = True
+        for (gi, s), k in step.get("propose", {}).items():
+            props[gi * R + s] = k
+        tick = step.get("tick", False)
+        eng.step_round(tick=tick, campaign_mask=jnp.asarray(camp),
+                       propose_n=jnp.asarray(props))
+        for gi, shadow in enumerate(shadows):
+            shadow.round(
+                campaigns=[s for (g2, s) in step.get("campaign", {})
+                           if g2 == gi],
+                proposals={s: k for (g2, s), k in
+                           step.get("propose", {}).items() if g2 == gi},
+                tick=tick,
+            )
+            for i in range(R):
+                role = int(shadow.nodes[i].raft.state)
+                if role == LEADER and prev_roles[gi][i] != LEADER:
+                    oracle_won += 1
+                prev_roles[gi][i] = role
+                c = shadow.nodes[i].raft.raft_log.committed
+                oracle_commit += c - prev_commit[gi][i]
+                prev_commit[gi][i] = c
+            # Outbound messages the oracle just routed (its next-round
+            # inbox): one device send flag == one oracle message.
+            oracle_sent += sum(
+                1 for tgt in shadow.inbox for snd in tgt
+                for m2 in snd if m2 is not None
+            )
+
+    counters, inv = eng.telemetry()
+    assert (inv == 0).all(), [decode_invariants(int(b)) for b in inv]
+    assert counters[:, TM_INDEX["elections_won"]].sum() == oracle_won
+    assert counters[:, TM_INDEX["commit_delta"]].sum() == oracle_commit
+    sent_cols = [TM_INDEX[nm] for nm in TM_NAMES if nm.startswith("sent_")]
+    assert counters[:, sent_cols].sum() == oracle_sent
+    # No proposals were dropped in this schedule, and every append the
+    # followers acked is visible.
+    assert counters[:, TM_INDEX["proposals_dropped"]].sum() == 0
+    assert counters[:, TM_INDEX["append_accepted"]].sum() > 0
+
+
+def test_hub_registry_fold_and_shapes(tmp_path):
+    """The hub folds per-round frames into labeled registry counters
+    and keeps a bounded ring."""
+    reg = pmet.Registry()
+    hub = TelemetryHub(4, member="2", registry=reg, ring=3, shards=2,
+                       dump_dir=str(tmp_path), dump_on_trip=False)
+    frame = np.zeros((4, NUM_COUNTERS), np.int64)
+    frame[0, TM_INDEX["sent_heartbeat"]] = 5
+    frame[3, TM_INDEX["sent_heartbeat"]] = 7
+    for _ in range(5):  # > ring size: the deque stays bounded
+        hub.ingest_round(frame, np.zeros(4, np.int64),
+                         extra={"outbox_lanes": [0, 1, 2, 3, 4, 5]})
+    assert len(hub.records()) == 3
+    text = reg.expose()
+    assert ('etcd_tpu_batched_sent_heartbeat_total'
+            '{member="2",shard="0"} 25') in text
+    assert ('etcd_tpu_batched_sent_heartbeat_total'
+            '{member="2",shard="1"} 35') in text
+    p = hub.dump(reason="unit")
+    assert os.path.exists(p)
+    rec = json.loads(open(p).read())
+    assert rec["ring"][-1]["extra"]["outbox_lanes"] == [0, 1, 2, 3, 4, 5]
+    assert rec["counter_names"] == list(TM_NAMES)
+
+    # Monotone-totals path: the engine's OR-folded invariant bitmap
+    # must count each trip ONCE across repeated chunk-boundary drains,
+    # and counter totals fold as deltas.
+    hub2 = TelemetryHub(4, member="3", registry=reg, shards=1,
+                        dump_dir=str(tmp_path), dump_on_trip=False)
+    totals = np.zeros((4, NUM_COUNTERS), np.int64)
+    totals[1, TM_INDEX["sent_append"]] = 10
+    inv = np.array([0, 1, 0, 0], np.int64)
+    hub2.ingest_totals(totals, inv)
+    totals2 = totals.copy()
+    totals2[1, TM_INDEX["sent_append"]] = 15
+    hub2.ingest_totals(totals2, inv)  # same bitmap: no new trips
+    assert hub2.trips() == 1
+    assert ('etcd_tpu_batched_sent_append_total'
+            '{member="3",shard="0"} 15') in reg.expose()
