@@ -153,14 +153,30 @@ class FaultyFabric:
     the wrapper splits every outbound batch by destination, consults the
     plan per link, and forwards the surviving (possibly delayed or
     duplicated) sub-batches to the original callables. Delayed
-    deliveries run on one pump thread ordered by due time."""
+    deliveries run on one pump thread ordered by due time; deliveries
+    whose target crashed while they were in flight are dropped (and
+    counted) — ``crash()`` tears the member's queues, and a harness
+    that restarts the member must not have pre-crash frames leak into
+    the fresh incarnation through the fabric's delay heap."""
 
-    def __init__(self, plan: FaultPlan) -> None:
+    def __init__(self, plan: FaultPlan,
+                 incarnation_fn: Optional[
+                     Callable[[int], Optional[object]]] = None) -> None:
         self.plan = plan
+        # Target-incarnation seam for the delayed-delivery pump: maps a
+        # member id to an identity token for its CURRENT live
+        # incarnation (None = crashed/stopped). The harness wires this
+        # to its member table; the pump captures the token at enqueue
+        # and re-resolves at fire, so a frame outlives neither a crash
+        # NOR a crash+restart (a restarted member is a NEW incarnation
+        # whose queues the crash tore). None = always deliver.
+        self.incarnation_fn = incarnation_fn
         self._stats: Dict[str, int] = defaultdict(int)
         self._seq = itertools.count()
         self._cv = threading.Condition()
-        self._heap: List[Tuple[float, int, Callable[[], None]]] = []
+        # (due, seq, dst, token, n, deliver)
+        self._heap: List[Tuple[float, int, int, object, int,
+                               Callable[[], None]]] = []
         self._stopped = False
         self._pump = threading.Thread(target=self._pump_loop, daemon=True)
         self._pump.start()
@@ -211,10 +227,10 @@ class FaultyFabric:
             self._count("duplicated", n)
             # The duplicate trails slightly — same-instant duplicates
             # would coalesce in the per-(row,sender,lane) inbox anyway.
-            self._later(delay + 0.002, deliver)
+            self._later(delay + 0.002, dst, n, deliver)
         if delay > 0:
             self._count("delayed", n)
-            self._later(delay, deliver)
+            self._later(delay, dst, n, deliver)
         else:
             self._run(deliver)
 
@@ -224,13 +240,21 @@ class FaultyFabric:
         except Exception:  # noqa: BLE001 — target died mid-delivery
             self._count("deliver_error")
 
-    def _later(self, delay: float, deliver: Callable[[], None]) -> None:
+    def _later(self, delay: float, dst: int, n: int,
+               deliver: Callable[[], None]) -> None:
+        tok = (self.incarnation_fn(dst)
+               if self.incarnation_fn is not None else None)
+        if self.incarnation_fn is not None and tok is None:
+            # Target already crashed at enqueue time.
+            self._count("crashed_drop", n)
+            return
         with self._cv:
             if self._stopped:
                 return
             heapq.heappush(
                 self._heap,
-                (time.monotonic() + delay, next(self._seq), deliver))
+                (time.monotonic() + delay, next(self._seq), dst, tok, n,
+                 deliver))
             self._cv.notify()
 
     def _pump_loop(self) -> None:
@@ -247,7 +271,18 @@ class FaultyFabric:
                         self._cv.wait()
                 if self._stopped:
                     return
-                _due, _seq, deliver = heapq.heappop(self._heap)
+                (_due, _seq, dst, tok, n,
+                 deliver) = heapq.heappop(self._heap)
+            # Incarnation check AT FIRE TIME against the token captured
+            # at enqueue: the member may have crashed — or crashed AND
+            # restarted — while the frame sat in the heap. An identity
+            # mismatch means the enqueue-time incarnation is gone, and
+            # its torn-away queues must not leak frames into a
+            # successor (observed as phantom traffic after crash()).
+            if self.incarnation_fn is not None \
+                    and self.incarnation_fn(dst) is not tok:
+                self._count("crashed_drop", n)
+                continue
             self._run(deliver)
 
     def stop(self) -> None:
@@ -306,12 +341,18 @@ class ChaosHarness:
                  cfg: Optional[BatchedConfig] = None,
                  transport: str = "inproc",
                  tick_interval: float = 0.02,
-                 pipeline: bool = True) -> None:
+                 pipeline: bool = True,
+                 fence: bool = True) -> None:
         assert transport in ("inproc", "tcp"), transport
         self.data_dir = data_dir
         self.seed = seed
         self.r = num_members
         self.g = num_groups
+        # fence=False disables the durability watermark + fenced-boot
+        # path on every member — the pre-PR behavior, kept so the
+        # torn-acked divergence stays demonstrable
+        # (tools/repro_progress_wedge.py --torn-acked).
+        self.fence = fence
         self.cfg = cfg or BatchedConfig(
             num_groups=num_groups, num_replicas=num_members,
             window=16, max_ents_per_msg=4, max_props_per_round=4,
@@ -328,7 +369,8 @@ class ChaosHarness:
         self.tick_interval = tick_interval
         self.pipeline = pipeline
         self.plan = FaultPlan(seed, spec)
-        self.fabric = FaultyFabric(self.plan)
+        self.fabric = FaultyFabric(
+            self.plan, incarnation_fn=self._member_incarnation)
         self.members: Dict[int, MultiRaftMember] = {}
         self.routers: Dict[int, TCPRouter] = {}
         self._ports: Dict[int, int] = {}  # stable rebind port per member
@@ -361,6 +403,7 @@ class ChaosHarness:
         m = MultiRaftMember(
             mid, self.r, self.g, self.data_dir, cfg=self.cfg,
             tick_interval=self.tick_interval, pipeline=self.pipeline,
+            fence=self.fence,
         )
         if self.inproc is not None:
             self.inproc.attach(m)
@@ -393,6 +436,13 @@ class ChaosHarness:
     def alive(self) -> List[MultiRaftMember]:
         return [m for m in self.members.values()
                 if not m._stopped.is_set()]
+
+    def _member_incarnation(self, mid: int) -> Optional[MultiRaftMember]:
+        """Incarnation seam for the fabric's delayed-delivery pump: the
+        member OBJECT is the identity token (a restart replaces it), or
+        None when the current incarnation is crashed/stopped."""
+        m = self.members.get(mid)
+        return m if (m is not None and not m._stopped.is_set()) else None
 
     # -- process faults --------------------------------------------------------
 
@@ -471,6 +521,40 @@ class ChaosHarness:
         _log.info("torn tail: member %d seg %s cut %d bytes at %d",
                   mid, segs[-1], chop, tail - chop)
         return chop
+
+    def torn_acked_tail(self, mid: int) -> Tuple[int, int]:
+        """DETERMINISTIC acked-loss tear: truncate the crashed member's
+        last WAL segment a few bytes INTO its final entry record, so an
+        fsync'd (and, if the write was acked, committed) entry is
+        verifiably destroyed with a mid-record break — the fault class
+        the durability fence exists for. Returns (bytes_chopped,
+        group_of_the_torn_entry); (0, -1) when the tail segment holds
+        no entry records (nothing acked to tear)."""
+        from ..native.walog import segment_records
+        from .hosting import RT_ENTRY
+
+        m = self.members[mid]
+        assert m._stopped.is_set(), "torn_acked_tail needs a crashed member"
+        wal_dir = os.path.join(self.data_dir, f"member-{mid}", "wal")
+        segs = sorted(f for f in os.listdir(wal_dir)
+                      if f.endswith(".wal"))
+        assert segs, "no WAL segments to tear"
+        path = os.path.join(wal_dir, segs[-1])
+        recs = [r for r in segment_records(path) if r[1] == RT_ENTRY]
+        if not recs:
+            return 0, -1
+        off, _rt, _ln, padded = recs[-1]
+        with open(path, "rb") as f:
+            f.seek(off + 12)  # record header: u32 len | u8 type | pad | crc
+            group = int.from_bytes(f.read(4), "little")
+        size = os.path.getsize(path)
+        cut = off + 12 + 5  # mid-payload: header survives, bytes don't
+        os.truncate(path, cut)
+        _log.info(
+            "torn acked tail: member %d seg %s cut %d bytes mid-entry "
+            "(group %d, record at %d)", mid, segs[-1], size - cut,
+            group, off)
+        return size - cut, group
 
     # -- workload --------------------------------------------------------------
 
@@ -579,21 +663,24 @@ def run_invariant_checks(harness: ChaosHarness,
                          allow_lag: int = 0) -> None:
     """Episode closer: the three chaos checkers in canonical order —
     per-group KV-hash parity, committed-never-lost, then (when an
-    observer ran) at-most-one-leader-per-(group, term). Torn-tail
-    episodes pass observer=None: tearing fsync'd bytes voids the
-    durability assumption election safety rests on.
+    observer ran) at-most-one-leader-per-(group, term). Since ISSUE 5
+    every episode class — torn tail included — closes STRICT
+    (allow_lag=0, observer on): the durability fence keeps a member
+    that verifiably lost fsync'd-acked bytes out of elections until it
+    re-converges, which removes the one mechanism that made torn-tail
+    divergence legal.
 
-    ``allow_lag=1`` relaxes both state checkers to quorum agreement —
-    for TORN-TAIL episodes, which tear fsync'd (possibly acked) bytes
-    and are therefore beyond raft's durability contract: a torn member
-    that wins an election with its shortened log can force a survivor
-    to overwrite an entry that survivor already COMMITTED AND APPLIED,
-    leaving its KV state divergent in a way no protocol can heal (the
-    reference has the same hole; root-caused here with the telemetry
-    flight recorder — the leader's match oscillates against the
-    survivor's below-commit fast-path ack at the conflicted commit
-    index). Safety within the contract (quorum durability, no
-    never-acked values, election safety) is still fully asserted.
+    ``allow_lag=1`` (legacy) relaxes both state checkers to quorum
+    agreement — the pre-fence accommodation for torn-tail episodes:
+    tearing fsync'd acked bytes let the torn member win an election
+    with its shortened log and force a survivor to overwrite an entry
+    it had already COMMITTED AND APPLIED, a KV divergence no protocol
+    heals after the fact (root-caused with the ISSUE 4 flight
+    recorder — the leader's match oscillates against the survivor's
+    below-commit fast-path ack at the conflicted commit index). The
+    knob remains for fence-disabled runs
+    (tools/repro_progress_wedge.py --torn-acked keeps the failure
+    demonstrable against ChaosHarness(fence=False)).
 
     When the harness flies with telemetry (the default config), the
     closer also asserts the on-device invariant sweep stayed clean —

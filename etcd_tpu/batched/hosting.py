@@ -17,7 +17,13 @@ groups per process", ref: raft/tracker/inflights.go:71-73): a
   persist (fsync) → apply → send → advance
   (ref: server/etcdserver/raft.go:226-268; apply-before-send lets
   outbound snapshot messages carry app state at an index ≥ the device
-  ring floor).
+  ring floor),
+* a per-group **durable watermark** WAL-recorded ahead of every entry
+  batch, so ``_replay`` can detect destroyed fsync'd-acked bytes (torn
+  tails beyond raft's durability model) and boot the damaged groups
+  **fenced** — out of elections until the probe/snapshot catch-up
+  restores the durable log ("Protocol-Aware Recovery for
+  Consensus-Based Storage", FAST'18).
 
 Members exchange per-round message batches. ``InProcRouter`` wires
 members in one process (tests, single-host demos); the TCP fabric for
@@ -39,7 +45,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..native.walog import Walog, WalogError, read_all as wal_read_all
+from ..native.walog import (
+    TAIL_CLEAN,
+    TAIL_NAMES,
+    Walog,
+    WalogError,
+    read_all_classified as wal_read_all_classified,
+)
 from ..pkg.failpoint import FailpointPanic, fp
 from ..raft.types import Message, MessageType, Snapshot, SnapshotMetadata
 from .rawnode import BatchedRawNode, BatchedReady, RowRestore
@@ -47,6 +59,7 @@ from .state import BatchedConfig, LEADER
 from .step import T_SNAP
 from .telemetry import (
     TelemetryHub,
+    fenced_groups_gauge,
     round_phase_histogram,
     router_loss_counter,
     wal_fsync_histogram,
@@ -63,6 +76,14 @@ _log = logging.getLogger("etcd_tpu.batched.hosting")
 RT_ENTRY = 1  # group:u32 index:u64 term:u64 len:u32 data
 RT_HARDSTATE = 2  # group:u32 term:u64 vote:u32 commit:u64
 RT_SNAPSHOT = 3  # same layout as RT_ENTRY; data = app snapshot
+# Durable watermark (protocol-aware torn-tail recovery, FAST'18): the
+# per-group (last_index, last_term, commit) this member is about to
+# make durable. Written FIRST in every persistence batch that appends
+# entries, fsync'd with the batch — so a tail cut that destroys the
+# batch's fsync'd entry records leaves their watermark behind, and
+# _replay can tell "acked bytes lost" (fence the group) from "crash
+# before the write" (nothing to do).
+RT_WATERMARK = 4  # group:u32 last:u64 last_term:u64 commit:u64
 
 
 def _pack_entry(group: int, index: int, term: int, data: bytes,
@@ -90,6 +111,14 @@ def _pack_snap(group: int, index: int, term: int, data: bytes) -> bytes:
 
 
 _unpack_snap = _unpack_entry
+
+
+def _pack_wm(group: int, last: int, last_term: int, commit: int) -> bytes:
+    return struct.pack("<IQQQ", group, last, last_term, commit)
+
+
+def _unpack_wm(b: bytes) -> Tuple[int, int, int, int]:
+    return struct.unpack_from("<IQQQ", b)
 
 
 class GroupKV:
@@ -142,6 +171,7 @@ class MultiRaftMember:
         send_fn: Optional[Callable[[int, List[Tuple[int, Message]]], None]] = None,
         pipeline: bool = True,
         mesh_devices: int = 0,
+        fence: bool = True,
     ) -> None:
         self.id = member_id
         self.slot = member_id - 1
@@ -194,6 +224,25 @@ class MultiRaftMember:
         self._read_opened: Dict[int, int] = {}
         self._read_results: Dict[int, Tuple[int, int]] = {}
         self._read_cv = threading.Condition()
+
+        # Durability fencing (protocol-aware torn-tail recovery): the
+        # watermark arrays hold the highest per-group (last, last_term,
+        # commit) this member ever WAL-recorded as durable; the _dur
+        # arrays track what actually IS durable right now. _replay
+        # fences any group whose recovered log fell below its watermark
+        # — acked bytes were destroyed — and the fence lifts when the
+        # durable log is back at the watermark (_maybe_lift_fences).
+        self.fence_enabled = bool(fence)
+        self._wm_last = np.zeros(num_groups, np.int64)
+        self._wm_term = np.zeros(num_groups, np.int64)
+        self._wm_commit = np.zeros(num_groups, np.int64)
+        self._dur_last = np.zeros(num_groups, np.int64)
+        self._dur_term = np.zeros(num_groups, np.int64)
+        self._dur_commit = np.zeros(num_groups, np.int64)
+        self._fenced = np.zeros(num_groups, bool)
+        self._tail_state: Optional[int] = None  # walog TAIL_* at boot
+        self._boot_fenced = 0
+        self._g_fenced = fenced_groups_gauge().labels(str(member_id))
 
         restore = self._replay()
         groups = np.arange(num_groups, dtype=np.int32)
@@ -279,7 +328,13 @@ class MultiRaftMember:
         rows: Dict[int, RowRestore] = defaultdict(RowRestore)
         ents: Dict[int, List[Tuple[int, int, bytes]]] = defaultdict(list)
         snaps: Dict[int, Tuple[int, int, bytes]] = {}
-        for rtype, data, _seq, _meta in wal_read_all(wal_dir):
+        wms: Dict[int, Tuple[int, int, int]] = {}
+        # read_all_classified snapshots the tail shape BEFORE the
+        # repairing read (which truncates the mid-record evidence) —
+        # the ordering protocol-aware recovery rests on, kept
+        # unbreakable inside the walog helper.
+        records, self._tail_state = wal_read_all_classified(wal_dir)
+        for rtype, data, _seq, _meta in records:
             if rtype == RT_HARDSTATE:
                 g, term, vote, commit = _unpack_hs(data)
                 rr = rows[g]
@@ -294,6 +349,13 @@ class MultiRaftMember:
                 g, i, t, d, _et = _unpack_snap(data)
                 snaps[g] = (i, t, d)
                 ents[g] = [e for e in ents[g] if e[0] > i]
+            elif rtype == RT_WATERMARK:
+                # Latest record wins: `last` legitimately moves DOWN on
+                # a conflict truncation (a new leader overwriting an
+                # uncommitted suffix), so a running max would
+                # false-fence a healthy member.
+                g, wl, wt, wc = _unpack_wm(data)
+                wms[g] = (wl, wt, wc)
         restore: Dict[int, RowRestore] = {}
         for g in set(rows) | set(ents) | set(snaps):
             rr = rows[g]
@@ -309,6 +371,54 @@ class MultiRaftMember:
             # here when a crash lands between the RT_SNAPSHOT record
             # and the next hardstate record.
             restore[g] = rr
+        # -- durable bookkeeping + fence decision per group ----------------
+        for g, rr in restore.items():
+            rec_last = rr.entries[-1][0] if rr.entries else rr.snap_index
+            rec_term = rr.entries[-1][1] if rr.entries else rr.snap_term
+            self._dur_last[g] = rec_last
+            self._dur_term[g] = rec_term
+            self._dur_commit[g] = max(rr.commit, rr.snap_index)
+        for g, (wl, wt, wc) in wms.items():
+            self._wm_last[g] = wl
+            self._wm_term[g] = wt
+            self._wm_commit[g] = wc
+            if not self.fence_enabled:
+                continue
+            rr = restore.get(g)
+            rec_last = self._dur_last[g] if rr is not None else 0
+            # Acked-durable bytes lost: the recovered log no longer
+            # reaches the watermark point (or holds an OLDER term
+            # there — unreachable from a pure tail cut, checked
+            # defensively). This replica's log/vote can no longer back
+            # its pre-crash promises: boot the row FENCED and let the
+            # snapshot/probe catch-up re-converge it (step.py fence
+            # lane; RowRestore.fenced → BatchedRawNode._restore).
+            below = rec_last < wl
+            if not below and rr is not None and wl > rr.snap_index:
+                terms = {i: t for i, t, *_ in rr.entries}
+                below = terms.get(wl, 0) < wt
+            # Term proof (mirrors _fence_lift_locked): a recovered log
+            # ENDING above the watermark's term supersedes the demand —
+            # the old suffix can never commit once a later-term leader
+            # replaced it (reachable when a crash lands between a
+            # term-rule lift and the next accurate watermark record).
+            if below and self._dur_term[g] > wt:
+                below = False
+            if below:
+                if rr is None:
+                    rr = restore[g] = rows[g]
+                rr.fenced = True
+                self._fenced[g] = True
+        self._boot_fenced = int(self._fenced.sum())
+        self._g_fenced.set(self._boot_fenced)
+        if self._boot_fenced or self._tail_state != TAIL_CLEAN:
+            _log.warning(
+                "member %d: WAL tail %s; %d group(s) below durable "
+                "watermark -> fenced (campaign/vote suppressed until "
+                "catch-up): %s", self.id,
+                TAIL_NAMES.get(self._tail_state, self._tail_state),
+                self._boot_fenced,
+                np.nonzero(self._fenced)[0][:16].tolist())
         return restore
 
     # -- loops -----------------------------------------------------------------
@@ -420,30 +530,89 @@ class MultiRaftMember:
 
     def _process_readys(self, batch: List[BatchedReady]) -> None:
         """Persist (one fsync for the whole batch) → apply → send, in
-        round order."""
+        round order. Watermark records go FIRST: a tail cut destroying
+        this batch's fsync'd entry records then still leaves the record
+        that demanded them, so _replay detects the loss and fences."""
         fp(self._fp_before_save)  # crash-before-WAL-save injection site
         t0 = time.perf_counter()
+        lifts: List[int] = []
         with self._lock:
             if self._crashed:
                 return  # simulated kill: queued Readys are torn away
             must_sync = False
+            # Per-group durable deltas across the whole batch:
+            # row -> [last, last_term, commit, has_entries]. Entries
+            # replay in order, so the final entry processed IS the new
+            # last (truncate-and-append semantics included).
+            wm: Dict[int, List[int]] = {}
+
+            def _wm_row(row: int) -> List[int]:
+                ent = wm.get(row)
+                if ent is None:
+                    ent = wm[row] = [
+                        int(self._dur_last[row]), int(self._dur_term[row]),
+                        int(self._dur_commit[row]), 0,
+                    ]
+                return ent
+
+            for rd in batch:
+                for row, _term, _vote, commit in rd.hardstates:
+                    ent = _wm_row(row)
+                    if commit > ent[2]:
+                        ent[2] = commit
+                for row, i, t, _d, _et in rd.entries:
+                    ent = _wm_row(row)
+                    ent[0], ent[1], ent[3] = i, t, 1
+                must_sync |= rd.must_sync
+            if self.fence_enabled:
+                for row in sorted(wm):
+                    last, lterm, commit, has_ents = wm[row]
+                    if not has_ents:
+                        continue  # commit-only: no durability promise moves
+                    if self._fenced[row] and last < self._wm_last[row]:
+                        # Never lower the demand mid-heal: a crash
+                        # during catch-up must re-fence at the original
+                        # pre-loss watermark, not the partial one.
+                        last = int(self._wm_last[row])
+                        lterm = int(self._wm_term[row])
+                    if self._fenced[row]:
+                        commit = max(commit, int(self._wm_commit[row]))
+                    self.wal.append(
+                        RT_WATERMARK, _pack_wm(row, last, lterm, commit))
             for rd in batch:
                 for row, term, vote, commit in rd.hardstates:
                     self.wal.append(
                         RT_HARDSTATE, _pack_hs(row, term, vote, commit))
                 for row, i, t, d, et in rd.entries:
                     self.wal.append(RT_ENTRY, _pack_entry(row, i, t, d, et))
-                must_sync |= rd.must_sync
             if must_sync:
                 tf = time.perf_counter()
                 self.wal.flush(sync=True)
                 if self._h_fsync is not None:
                     self._h_fsync.observe(time.perf_counter() - tf)
+            # Durable mirrors move only once the records are fsync'd
+            # (entries always set must_sync); the commit mirror rides
+            # along unsynced — it gates nothing in the fence protocol.
+            for row, (last, lterm, commit, has_ents) in wm.items():
+                if has_ents and must_sync:
+                    self._dur_last[row] = last
+                    self._dur_term[row] = lterm
+                    if not self._fenced[row]:
+                        # Track the recorded watermark for healthy rows
+                        # (fenced rows keep demanding the boot-time
+                        # watermark until the lift below).
+                        self._wm_last[row] = last
+                        self._wm_term[row] = lterm
+                        self._wm_commit[row] = max(
+                            self._wm_commit[row], commit)
+                self._dur_commit[row] = max(self._dur_commit[row], commit)
+            lifts = self._fence_lift_locked()
         dt = time.perf_counter() - t0
         self.stats["wal_s"] += dt
         if self._h_phase is not None:
             self._h_phase["wal"].observe(dt)
         self.stats["batched"] += len(batch)
+        self._fence_lift_apply(lifts)
         fp(self._fp_after_save)  # crash-after-save-before-apply site
         for rd in batch:
             self._apply_and_send(rd)
@@ -519,6 +688,79 @@ class MultiRaftMember:
         if self._h_phase is not None:
             self._h_phase["send"].observe(dt)
 
+    # -- durability fence ------------------------------------------------------
+
+    def _fence_lift_locked(self) -> List[int]:
+        """Collect fenced groups that re-proved durability (caller
+        holds _lock); flips the host mirror, leaves the device edit to
+        _fence_lift_apply (outside the lock). Two sufficient proofs:
+
+        * **index**: the durable log reaches the watermark point again
+          (``dur_last >= wm_last``) — every pre-crash promise is backed
+          by fsync'd bytes once more;
+        * **term**: the durable log ENDS in a term above the
+          watermark's (``dur_term > wm_term``). A later-term leader was
+          elected by a quorum of non-fenced members (this member
+          granted nothing while fenced), so by Leader Completeness its
+          log carries every entry committed at terms <= wm_term; the
+          prefix-matched append that landed the later-term entry
+          therefore proves the un-recovered old suffix could never
+          have been committed. Without this rule a FALSE fence — a
+          kill mid-write persisting a batch's watermark but not its
+          (never-acked) entries — wedges an idle group forever: the
+          new leader's log is legitimately shorter than the
+          overshooting watermark, so the index proof alone never
+          arrives.
+        """
+        if not self.fence_enabled or not self._fenced.any():
+            return []
+        lifts: List[int] = []
+        for row in np.nonzero(self._fenced)[0]:
+            if (self._dur_last[row] >= self._wm_last[row]
+                    or self._dur_term[row] > self._wm_term[row]):
+                self._fenced[row] = False
+                lifts.append(int(row))
+        return lifts
+
+    def _fence_lift_apply(self, lifts: List[int]) -> None:
+        """Stage the device-side fence drop for healed groups (the
+        rawnode applies it at the head of the next round) and move the
+        gauge. The durable log re-reaching the watermark point means
+        every pre-crash promise is backed by fsync'd bytes again —
+        terms at a given index never regress across leaders, so the
+        comparison needs no term recheck."""
+        if not lifts:
+            return
+        for row in lifts:
+            self.rn.set_fence(row, False)
+        remaining = int(self._fenced.sum())
+        self._g_fenced.set(remaining)
+        _log.info(
+            "member %d: durability fence lifted for group(s) %s "
+            "(%d still fenced)", self.id, lifts[:16], remaining)
+        self._work.set()
+
+    def health(self) -> Dict[str, object]:
+        """Fence/catch-up visibility (admin 'health' op): per-group
+        fenced state, index gap to the durable watermark, and the boot
+        WAL-tail classification (walog tail_state)."""
+        with self._lock:
+            fenced = np.nonzero(self._fenced)[0]
+            gaps = {
+                int(g): int(self._wm_last[g] - self._dur_last[g])
+                for g in fenced
+            }
+        return {
+            "fence_enabled": self.fence_enabled,
+            "wal_tail": (TAIL_NAMES.get(self._tail_state, "unknown")
+                         if self._tail_state is not None else "fresh"),
+            "fenced_groups": [int(g) for g in fenced],
+            "catchup_gap": gaps,
+            "boot_fenced": self._boot_fenced,
+            "crashed": self._crashed,
+            "stopped": self._stopped.is_set(),
+        }
+
     # -- wire ------------------------------------------------------------------
 
     def deliver(self, group: int, m: Message) -> None:
@@ -530,6 +772,7 @@ class MultiRaftMember:
             # under _lock so run_round's apply step can't interleave
             # stale entries into the freshly restored state.
             idx = m.snapshot.metadata.index
+            lifts: List[int] = []
             with self._lock:
                 if self._stopped.is_set():
                     # Re-check under _lock: a crash() that won the lock
@@ -537,6 +780,7 @@ class MultiRaftMember:
                     # to (the unlocked check above is advisory only).
                     return
                 if idx > self.applied_index[group]:
+                    snap_term = m.snapshot.metadata.term
                     self.kvs[group].restore(m.snapshot.data)
                     self.applied_index[group] = idx
                     self.rn.install_snapshot_state(group, idx)
@@ -544,10 +788,36 @@ class MultiRaftMember:
                     # state can be acknowledged.
                     self.wal.append(
                         RT_SNAPSHOT,
-                        _pack_snap(group, idx, m.snapshot.metadata.term,
+                        _pack_snap(group, idx, snap_term,
                                    m.snapshot.data),
                     )
+                    # Snapshot-driven heal: the install makes (idx,
+                    # snap_term) durable and committed, so the durable
+                    # mirrors jump with it and a fence demanding
+                    # anything at-or-below idx lifts right here —
+                    # protocol-aware re-convergence needs no log
+                    # replay when the quorum ships state directly.
+                    if idx > self._dur_last[group]:
+                        self._dur_last[group] = idx
+                        self._dur_term[group] = snap_term
+                    self._dur_commit[group] = max(
+                        self._dur_commit[group], idx)
+                    if self.fence_enabled:
+                        wl = max(idx, int(self._wm_last[group]))
+                        wt = (snap_term if wl == idx
+                              else int(self._wm_term[group]))
+                        self.wal.append(
+                            RT_WATERMARK,
+                            _pack_wm(group, wl, wt,
+                                     max(idx, int(self._wm_commit[group]))))
+                        if not self._fenced[group]:
+                            self._wm_last[group] = wl
+                            self._wm_term[group] = wt
+                            self._wm_commit[group] = max(
+                                self._wm_commit[group], idx)
                     self.wal.flush(sync=True)
+                    lifts = self._fence_lift_locked()
+            self._fence_lift_apply(lifts)
         self.rn.step(group, m)
         self._work.set()
 
@@ -1283,13 +1553,15 @@ class MultiRaftCluster:
                  num_groups: int = 16,
                  cfg: Optional[BatchedConfig] = None,
                  pipeline: bool = True,
-                 mesh_devices: int = 0) -> None:
+                 mesh_devices: int = 0,
+                 fence: bool = True) -> None:
         self.router = InProcRouter()
         self.members: Dict[int, MultiRaftMember] = {}
         for mid in range(1, num_members + 1):
             m = MultiRaftMember(
                 mid, num_members, num_groups, data_dir, cfg=cfg,
                 pipeline=pipeline, mesh_devices=mesh_devices,
+                fence=fence,
             )
             self.router.attach(m)
             self.members[mid] = m

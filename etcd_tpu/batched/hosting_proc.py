@@ -158,6 +158,12 @@ class AdminServer:
                 rstats = rs()
             return {"ok": True, "member": dict(m.stats),
                     "router": rstats}
+        if op == "health":
+            # Durability-fence visibility (protocol-aware torn-tail
+            # recovery): per-group fenced state, the index gap still to
+            # close to the durable watermark, and the boot WAL-tail
+            # classification (clean boundary vs mid-record break).
+            return {"ok": True, **m.health()}
         if op == "metrics":
             # Prometheus text exposition of the process registry —
             # kernel telemetry counters, invariant trips, WAL fsync /

@@ -182,6 +182,11 @@ def invariant_bits(st, slot) -> jnp.ndarray:
             & (st.pending_snapshot <= st.match)),
         # a confirmed read batch with no batch open.
         st.read_ready & (st.read_index < 0),
+        # a durability-fenced instance holding leadership: the fence
+        # suppresses campaigning (and boot roles are follower), so a
+        # fenced leader means the fence lane failed to gate an
+        # election path — the exact hazard the fence exists to close.
+        st.fenced & is_leader,
     ]
     bits = jnp.zeros((), I32)
     for i, b in enumerate(bad):

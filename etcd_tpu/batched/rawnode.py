@@ -108,6 +108,11 @@ class RowRestore:
     # Membership at the snapshot point (None → full-voter bootstrap;
     # committed conf entries in the tail re-apply through Ready).
     conf_state: Optional[object] = None
+    # Durability fence (protocol-aware torn-tail recovery): the hosting
+    # layer sets this when the recovered WAL tail fell below the
+    # group's durable watermark — the row boots with campaigning and
+    # vote-granting suppressed until set_fence(row, False) lifts it.
+    fenced: bool = False
 
 
 @dataclass
@@ -265,6 +270,7 @@ class BatchedRawNode:
         # row -> requested ring-floor index.
         self._pending_conf: Dict[int, Tuple] = {}
         self._pending_compact: Dict[int, int] = {}
+        self._pending_fence: Dict[int, bool] = {}
         self._read_seen = np.zeros(self.n, np.int64)  # last surfaced seq
         self._read_seq_prev = np.zeros(self.n, np.int64)  # open detection
         self._snap_staged: Dict[int, Tuple[int, int]] = {}  # row->(idx,term)
@@ -302,7 +308,9 @@ class BatchedRawNode:
         snap_i = np.zeros(self.n, np.int32)
         snap_t = np.zeros(self.n, np.int32)
         ring = np.zeros((self.n, w), np.int32)
+        fenced = np.zeros(self.n, bool)
         for row, rr in restore.items():
+            fenced[row] = rr.fenced
             term[row] = rr.term
             vote[row] = rr.vote
             # A snapshot at snap_index proves snap_index was committed;
@@ -333,6 +341,7 @@ class BatchedRawNode:
             snap_index=self._dev(snap_i),
             snap_term=self._dev(snap_t),
             log_term=self._dev(ring),
+            fenced=self._dev(fenced),
             next=self._dev(
                 np.repeat(last[:, None] + 1, cfg.num_replicas, axis=1)
             ),
@@ -415,6 +424,15 @@ class BatchedRawNode:
         with self._lock:
             self._read_req[row] = True
 
+    def set_fence(self, row: int, on: bool) -> None:
+        """Stage a durability-fence flip for one row (hosting layer:
+        lift when the durable log is back at the watermark, re-arm on
+        a detected regression). STAGED like set_membership — the state
+        edit lands at the head of the next round on the round thread,
+        never racing the round's state swap."""
+        with self._lock:
+            self._pending_fence[row] = bool(on)
+
     def pending_proposals(self, row: int) -> int:
         with self._lock:
             return len(self._props[row])
@@ -491,6 +509,7 @@ class BatchedRawNode:
             if (
                 self._pending or self._blocks or self._poked
                 or self._pending_conf or self._pending_compact
+                or self._pending_fence
                 or self._ticks.any()
                 or self._campaign.any()
                 or self._transfer.any()
@@ -531,6 +550,8 @@ class BatchedRawNode:
             self._pending_conf = {}
             pend_compact = self._pending_compact
             self._pending_compact = {}
+            pend_fence = self._pending_fence
+            self._pending_fence = {}
             props_n = np.fromiter(
                 (min(len(q), cfg.max_props_per_round) for q in self._props),
                 np.int32, count=self.n,
@@ -557,6 +578,15 @@ class BatchedRawNode:
                 voter_out=st0.voter_out.at[ridx].set(jnp.asarray(vout)),
                 learner=st0.learner.at[ridx].set(jnp.asarray(lrn)),
                 in_joint=st0.in_joint.at[ridx].set(jnp.asarray(jnt)),
+            )
+        if pend_fence:
+            st0 = self.state
+            rows2 = np.fromiter(pend_fence, np.int32, len(pend_fence))
+            vals = np.fromiter((pend_fence[int(r2)] for r2 in rows2),
+                               bool, len(rows2))
+            self.state = st0._replace(
+                fenced=st0.fenced.at[jnp.asarray(rows2)]
+                .set(jnp.asarray(vals)),
             )
         if pend_compact:
             for row2, want in pend_compact.items():

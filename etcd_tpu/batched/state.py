@@ -155,6 +155,15 @@ class BatchedState(NamedTuple):
     learner: jnp.ndarray  # [N, R] bool
     in_joint: jnp.ndarray  # [N] bool
 
+    # Durability fence (protocol-aware recovery, FAST'18): set at boot
+    # for instances whose recovered WAL tail fell below the durable
+    # watermark (acked bytes destroyed). A fenced instance neither
+    # campaigns nor grants votes — its log/vote state can no longer
+    # back the promises it made — but still accepts appends/heartbeats,
+    # re-converging as a de-facto learner until the hosting layer lifts
+    # the fence (durable log back at the watermark).
+    fenced: jnp.ndarray  # [N] bool
+
     # Leader transfer (ref: raft.go:1339-1372; raft.leadTransferee).
     transferee: jnp.ndarray  # [N] i32, slot+1; 0 = no transfer pending
     transfer_sent: jnp.ndarray  # [N] bool — TimeoutNow already emitted
@@ -275,6 +284,7 @@ def init_state(cfg: BatchedConfig, start_index: int = 0,
         voter_out=jnp.zeros((n, r), bool),
         learner=jnp.zeros((n, r), bool),
         in_joint=jnp.zeros((n,), bool),
+        fenced=jnp.zeros((n,), bool),
         transferee=zeros_n(),
         transfer_sent=jnp.zeros((n,), bool),
         read_seq=zeros_n(),

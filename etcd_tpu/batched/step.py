@@ -353,7 +353,12 @@ def _lane_vote(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
     up_to_date = (m.log_term > last_term) | (
         (m.log_term == last_term) & (m.index >= st1.last)
     )
-    grant = can_vote & up_to_date
+    # Durability-fenced instances grant nothing (vote or pre-vote): a
+    # fence means this replica verifiably lost fsync'd-acked state at
+    # its last crash, so neither its log comparison nor its persisted
+    # vote can back the election-safety promises a grant makes
+    # (protocol-aware recovery, FAST'18).
+    grant = can_vote & up_to_date & ~st1.fenced
     resp_type = jnp.where(m.type == T_VOTE, T_VOTE_RESP, T_PREVOTE_RESP)
     vote_resp = no_resp._replace(
         valid=True,
@@ -440,14 +445,17 @@ def _lane_hb(cfg: BatchedConfig, iid, slot, st: BatchedState, m: MsgSlots,
     leader_traffic_ok = st1.role != LEADER
 
     # MsgTimeoutNow: campaign at once regardless of timers; only
-    # promotable instances honor it (raft.go:1465-1472 + hup gating).
+    # promotable instances honor it (raft.go:1465-1472 + hup gating) —
+    # and never a durability-fenced one (the fence exists to keep this
+    # replica out of elections until its durable log is whole again).
     is_ton = m.type == T_TIMEOUT_NOW
     r = st1.match.shape[-1]
     promotable = _pick_b(_vote_targets(st1), jnp.arange(r, dtype=I32) == slot)
     st_ton = _campaign(cfg, st1, iid, slot, False, transfer=True)
 
     st_live = _sel(leader_traffic_ok,
-                   _sel(is_ton & promotable, st_ton, st_hb), st1)
+                   _sel(is_ton & promotable & ~st1.fenced, st_ton, st_hb),
+                   st1)
     resp_live = _sel(leader_traffic_ok & ~is_ton, hb_resp, no_resp)
 
     stale = lower & jnp.asarray(cfg.check_quorum or cfg.pre_vote) & ~is_ton
@@ -880,11 +888,15 @@ def _tick(cfg: BatchedConfig, iid, slot, st: BatchedState, do_tick,
         )
 
     # Follower/candidate election firing (hup gated on promotability —
-    # learners never campaign, ref: raft.go:760-784).
+    # learners never campaign, ref: raft.go:760-784). Durability-fenced
+    # instances never fire: campaigning on a log that verifiably lost
+    # acked entries is how a torn member forces a survivor to overwrite
+    # a committed entry (the out-of-contract divergence the fence
+    # closes); the fence also swallows host-staged campaign nudges.
     promotable = _pick_b(_vote_targets(st), peers == slot)
     fire = (
         (~is_leader & (ee >= st.randomized_timeout)) | do_campaign
-    ) & promotable & (st.role != LEADER)
+    ) & promotable & (st.role != LEADER) & ~st.fenced
     st1 = st1._replace(
         election_elapsed=jnp.where(fire & ~is_leader, 0, st1.election_elapsed)
     )
@@ -1177,6 +1189,7 @@ def _telemetry_frame(cfg: BatchedConfig, slot, pre: BatchedState,
         post.commit - pre.commit,
         (post.read_ready & ~pre.read_ready).astype(I32),
         jnp.maximum(jnp.maximum(n_new, 0) - appended, 0),
+        post.fenced.astype(I32),
     )
     counters = jnp.stack([jnp.asarray(c, I32) for c in cols])
     assert counters.shape == (NUM_COUNTERS,)

@@ -67,6 +67,7 @@ TM_NAMES = (
     "commit_delta",        # commit-index advance this round
     "reads_confirmed",     # ReadIndex batches quorum-confirmed
     "proposals_dropped",   # staged proposals the device did not append
+    "fenced_rounds",       # rounds spent durability-fenced (PAR rejoin)
 )
 NUM_COUNTERS = len(TM_NAMES)
 TM_INDEX = {n: i for i, n in enumerate(TM_NAMES)}
@@ -83,6 +84,7 @@ INV_NAMES = (
     # restarted-member wedge signature — see CHANGES.md PR 4)
     "snapshot_stuck",       # SNAPSHOT state with pending <= match
     "read_ready_no_batch",  # confirmed read with no batch open
+    "fenced_leader",        # durability-fenced instance became leader
 )
 
 
@@ -137,6 +139,21 @@ def round_phase_histogram(
         ("member", "phase"),
         buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                  0.25, 0.5, 1.0),
+    ))
+
+
+def fenced_groups_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    """Per-member count of groups currently durability-fenced (torn
+    acked bytes detected at _replay; drops back to 0 as the snapshot/
+    probe catch-up lifts each fence). Set by the hosting layer at boot
+    and on every lift — no per-round cost."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_batched_fenced_groups",
+        "groups currently fenced out of elections after durable-loss "
+        "detection (protocol-aware torn-tail recovery)",
+        ("member",),
     ))
 
 

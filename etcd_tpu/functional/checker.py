@@ -112,9 +112,11 @@ def multiraft_hash_check(members: Sequence, timeout: float = 30.0,
     proves: per group, at least ``len(members) - k`` members must agree
     on (applied, hash); up to k members may lag behind (a follower
     being behind is a liveness condition every live cluster passes
-    through, not a safety violation). Used by episodes that trip the
-    known restarted-leader progress wedge (ROADMAP open item) — strict
-    parity (k=0) stays the default."""
+    through, not a safety violation). Strict parity (k=0) is the
+    default and — since the ISSUE 5 durability fence — what every
+    chaos episode class asserts; the relaxation remains for
+    fence-disabled runs that deliberately re-open the torn-tail
+    divergence (tools/repro_progress_wedge.py --torn-acked)."""
     import numpy as np
 
     members = list(members)

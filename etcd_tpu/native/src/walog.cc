@@ -483,6 +483,81 @@ int walog_release_before(void* wp, uint64_t meta) {
   return int(keep_from);
 }
 
+// Classify the shape of the LAST segment's tail WITHOUT repairing —
+// the protocol-aware recovery detector (ref: "Protocol-Aware Recovery
+// for Consensus-Based Storage", FAST'18: lost durable data must be
+// treated as a distinct fault, not silently truncated away). Call
+// BEFORE walog_read_all(repair=1): repair truncates the evidence.
+//
+// Return codes (keep in sync with walog.py TAIL_*):
+//   0 = clean: the segment ends exactly at a record boundary with a
+//       valid chain — either nothing was being written at the crash,
+//       or fsync'd whole records were sheared off at a boundary (which
+//       only a higher-level durability watermark can detect);
+//   1 = torn: the tail ends INSIDE a record — a header or payload
+//       running past EOF, a zero-sector torn write, or sub-header
+//       garbage. Bytes beyond the last whole record are gone;
+//   2 = corrupt: a complete record fails its crc (non-repairable;
+//       walog_read_all refuses these too);
+//  <0 = error (err filled in).
+int walog_tail_state(const char* dir_c, char* err, int errlen) {
+  crc_init();
+  std::vector<Segment> segs;
+  std::string emsg;
+  if (list_segments(dir_c, &segs, &emsg) != 0) {
+    set_err(err, errlen, emsg);
+    return -1;
+  }
+  if (segs.empty()) {
+    set_err(err, errlen, "no wal segments");
+    return -1;
+  }
+  const Segment& tail = segs.back();
+  int fd = open(tail.path.c_str(), O_RDONLY);
+  if (fd < 0) {
+    set_err(err, errlen, "open " + tail.path + ": " + strerror(errno));
+    return -1;
+  }
+  struct stat st;
+  fstat(fd, &st);
+  std::vector<uint8_t> data(size_t(st.st_size));
+  ssize_t rd = pread(fd, data.data(), data.size(), 0);
+  close(fd);
+  if (rd < 0) {
+    set_err(err, errlen, std::string("pread: ") + strerror(errno));
+    return -1;
+  }
+  data.resize(size_t(rd));
+  // Validate the segment standalone: the seed record carries the chain
+  // crc entering this segment, so the per-record checks need no
+  // earlier segments.
+  size_t off = 0;
+  uint32_t crc = 0;
+  bool first = true;
+  while (off + kHeader <= data.size()) {
+    uint32_t len32, rcrc;
+    memcpy(&len32, &data[off], 4);
+    uint8_t type = data[off + 4];
+    memcpy(&rcrc, &data[off + 8], 4);
+    size_t total = kHeader + len32;
+    size_t padded = (total + 7) & ~size_t(7);
+    if (off + padded > data.size()) return 1;  // record past EOF: torn
+    if (first) {
+      if (type != kTypeCrcReset) return 2;
+      crc = rcrc;
+      first = false;
+    } else {
+      uint32_t want = crc32c(crc, &data[off + kHeader], len32);
+      if (want != rcrc) return is_torn_record(data, off, padded) ? 1 : 2;
+      crc = want;
+    }
+    off += padded;
+  }
+  if (off < data.size()) return 1;  // sub-header tail garbage: torn
+  if (first) return 1;  // no complete seed record survived
+  return 0;
+}
+
 // Stream every record of every segment (in order) through cb, after
 // validating the crc chain. Torn tails in the LAST segment are truncated
 // (repair=1) or reported as the stop point; corruption elsewhere is an

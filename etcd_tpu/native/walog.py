@@ -40,6 +40,10 @@ def _lib() -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_int, _REC_CB, ctypes.c_void_p,
         ctypes.c_char_p, ctypes.c_int,
     ]
+    lib.walog_tail_state.restype = ctypes.c_int
+    lib.walog_tail_state.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+    ]
     lib.walog_close.argtypes = [ctypes.c_void_p]
     for fn in ("walog_tail_offset", "walog_tail_seq", "walog_last_sync_ns",
                "walog_total_syncs", "walog_total_sync_ns"):
@@ -132,6 +136,64 @@ def read_all(dirpath: str, repair: bool = True) -> List[Tuple[int, bytes, int, i
     )
     if rc < 0:
         raise WalogError(err.value.decode() or "walog_read_all failed")
+    return out
+
+
+# Tail-shape classification (walog_tail_state return values). The
+# distinction matters for protocol-aware recovery (FAST'18): a CLEAN
+# boundary proves only that no record was mid-write at the crash, while
+# a TORN mid-record break proves bytes beyond the last whole record
+# were destroyed — if the file's contents were fsync-acknowledged, that
+# is lost durable data, a fault class raft's model does not cover.
+TAIL_CLEAN, TAIL_TORN, TAIL_CORRUPT = 0, 1, 2
+TAIL_NAMES = {TAIL_CLEAN: "clean", TAIL_TORN: "torn",
+              TAIL_CORRUPT: "corrupt"}
+
+
+def tail_state(dirpath: str) -> int:
+    """Classify the LAST segment's tail: TAIL_CLEAN (ends exactly at a
+    record boundary, chain valid), TAIL_TORN (ends inside a record —
+    the mid-record CRC break / past-EOF shapes), or TAIL_CORRUPT (a
+    complete record fails its crc). Call BEFORE read_all(repair=True):
+    repair truncates the torn evidence back to a clean boundary."""
+    lib = _lib()
+    err = ctypes.create_string_buffer(512)
+    rc = lib.walog_tail_state(dirpath.encode(), err, len(err))
+    if rc < 0:
+        raise WalogError(err.value.decode() or "walog_tail_state failed")
+    return rc
+
+
+def read_all_classified(
+    dirpath: str, repair: bool = True,
+) -> Tuple[List[Tuple[int, bytes, int, int]], int]:
+    """read_all plus the tail classification taken BEFORE any repair:
+    (records, TAIL_*). The recovery path (hosting._replay) uses the
+    classification to distinguish a benign crash boundary from a
+    mid-record break that destroyed bytes."""
+    ts = tail_state(dirpath)
+    return read_all(dirpath, repair=repair), ts
+
+
+def segment_records(path: str) -> List[Tuple[int, int, int, int]]:
+    """Frame-walk one segment file WITHOUT crc validation:
+    [(offset, rtype, payload_len, padded_size)] for every complete
+    record (the CRC-reset seed included). Tooling/test helper for
+    locating record boundaries (e.g. to place a deterministic
+    mid-record tear); stops at the first record running past EOF."""
+    import struct as _struct
+
+    out: List[Tuple[int, int, int, int]] = []
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    while off + 12 <= len(data):
+        ln, rtype = _struct.unpack_from("<IB", data, off)
+        padded = (12 + ln + 7) & ~7
+        if off + padded > len(data):
+            break
+        out.append((off, rtype, ln, padded))
+        off += padded
     return out
 
 

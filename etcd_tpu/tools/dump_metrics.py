@@ -64,6 +64,7 @@ def dump_local(names_only: bool = False) -> int:
     btel.wal_fsync_histogram()
     btel.round_phase_histogram()
     btel.router_loss_counter()
+    btel.fenced_groups_gauge()
     for line in pmet.DEFAULT.expose().splitlines():
         if line.startswith("#"):
             continue

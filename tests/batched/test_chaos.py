@@ -179,11 +179,17 @@ class TestTornTail:
         """Crash a member, truncate its last WAL segment at an
         arbitrary byte (a torn write), and verify restart recovers the
         valid prefix through wal_read_all's repair instead of raising —
-        then the survivors re-replicate the torn-away tail."""
+        then the survivors re-replicate the torn-away tail. Since
+        ISSUE 5 the durable watermark fences any group whose acked
+        bytes the chop severed (so the torn member cannot win an
+        election mid-heal) and the episode closes with ALL THREE
+        checkers, election safety included."""
         seed = SEEDS[0]
         h = make_harness(tmp_path, seed, FaultSpec())
+        obs = LeaderObserver(h.alive)
         try:
             h.wait_leaders()
+            obs.start()
             h.run_workload(8, prefix=b"pre")
             h.crash(3)
             chop = h.torn_tail(3)
@@ -191,15 +197,13 @@ class TestTornTail:
             h.run_workload(4, prefix=b"mid")
             h.restart(3)  # must NOT raise on the torn segment
             h.wait_leaders()
-            # The chop may tear ACKED bytes (beyond raft's durability
-            # contract); a write per group re-heals every log via the
-            # leader's conflict probe — see touch_all_groups.
+            # The chop may tear ACKED bytes; a write per group re-heals
+            # every log via the leader's conflict probe (and lifts any
+            # fence the tear armed) — see touch_all_groups.
             h.touch_all_groups()
-            # Hash parity + no acked write lost (the torn member's
-            # missing suffix comes back from the quorum); observer=None
-            # scopes out the leader checker — see run_invariant_checks.
-            run_invariant_checks(h, None, expect_members=R)
+            run_checkers(h, obs)
         finally:
+            obs.stop()
             h.stop()
 
 

@@ -188,6 +188,18 @@ def test_three_process_cluster_kill9_restart(tmp_path):
         procs[3] = spawn(3, raft_p, admin_p, str(tmp_path), gen=1)
         clients[3] = wait_admin(("127.0.0.1", admin_p[3]), timeout=180.0)
 
+        # Durability-fence visibility (ISSUE 5): the health op reports
+        # the boot WAL-tail classification and per-group fenced state.
+        # A real kill -9 of a process whose WAL batches fsync before
+        # acks normally leaves a clean boundary and nothing fenced;
+        # either way the op must answer and any fence must heal.
+        hl = clients[3].call(op="health")
+        assert hl.get("ok"), hl
+        assert hl["fence_enabled"] is True
+        assert hl["wal_tail"] in ("clean", "torn"), hl
+        assert isinstance(hl["fenced_groups"], list)
+        assert isinstance(hl["catchup_gap"], dict)
+
         deadline = time.monotonic() + 120.0
         want = {g: b"v%d" % g for g in sample}
         want[g3] = want[g3]  # original key still present
@@ -201,6 +213,16 @@ def test_three_process_cluster_kill9_restart(tmp_path):
             time.sleep(0.5)
         else:
             pytest.fail(f"restarted member did not catch up: {missing}")
+
+        # Any fence the kill armed must have healed along the catch-up.
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            hl = clients[3].call(op="health")
+            if hl.get("ok") and not hl["fenced_groups"]:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"fenced groups never healed: {hl}")
 
         # And it participates again: a fresh write lands everywhere.
         c = put_any(clients, g3, b"after-restart", b"2", timeout=60.0)

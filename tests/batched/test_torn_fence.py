@@ -1,0 +1,206 @@
+"""Protocol-aware torn-tail recovery (ISSUE 5): durability watermark +
+fenced rejoin.
+
+Tearing fsync'd ACKED bytes is outside raft's durability model — a torn
+member that campaigns with its shortened log can force a survivor to
+overwrite a committed-and-applied entry (the PR 4 flight-recorder
+finding). The fence closes that hole the FAST'18 protocol-aware-recovery
+way: every persistence batch WAL-records the per-group durable watermark
+FIRST, `_replay` compares the recovered tail against it (plus the WAL
+tail classifier: clean boundary vs mid-record break), and a damaged
+group boots FENCED — no campaigning, no vote grants — re-converging as a
+de-facto learner until its durable log is back at the watermark.
+
+The deterministic tier-1 tests here share test_chaos.py's tiny config so
+the jitted round program compiles once per pytest process; the
+multi-seed strict-parity soak lives in test_chaos_soak.py behind
+`-m slow`.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from etcd_tpu.batched.faults import (
+    ChaosHarness,
+    FaultSpec,
+    LeaderObserver,
+    run_invariant_checks,
+)
+from etcd_tpu.batched.state import BatchedConfig
+from etcd_tpu.native.walog import (
+    TAIL_CLEAN,
+    TAIL_CORRUPT,
+    TAIL_TORN,
+    Walog,
+    read_all_classified,
+    segment_records,
+    tail_state,
+)
+
+G, R = 8, 3
+# Value-identical to tests/batched/test_chaos.py CFG: _step_round_jit
+# caches the compiled round per config VALUE, so these tests reuse the
+# chaos subset's program instead of paying a second tier-1 compile.
+CFG = BatchedConfig(
+    num_groups=G, num_replicas=R, window=16, max_ents_per_msg=4,
+    max_props_per_round=4, election_timeout=10, heartbeat_timeout=1,
+    pre_vote=True, check_quorum=True, auto_compact=True,
+)
+
+
+# -- WAL tail classifier (no jax; satellite 1) ---------------------------------
+
+
+def _seg_path(wal_dir: str) -> str:
+    segs = sorted(f for f in os.listdir(wal_dir) if f.endswith(".wal"))
+    assert segs
+    return os.path.join(wal_dir, segs[-1])
+
+
+def _fresh_wal(tmp_path, n: int = 6) -> str:
+    wal_dir = str(tmp_path / "wal")
+    with Walog(wal_dir, create=True) as w:
+        for i in range(n):
+            w.append(1, b"payload-%d" % i * 3)
+        w.flush(sync=True)
+    return wal_dir
+
+
+def test_tail_classifier_clean_after_sync(tmp_path):
+    wal_dir = _fresh_wal(tmp_path)
+    assert tail_state(wal_dir) == TAIL_CLEAN
+
+
+def test_tail_classifier_mid_record_break(tmp_path):
+    """A cut INSIDE a record — the shape torn-tail chaos leaves — must
+    classify as torn, and read_all must still repair to the valid
+    prefix (after which the tail is clean again)."""
+    wal_dir = _fresh_wal(tmp_path)
+    path = _seg_path(wal_dir)
+    recs = segment_records(path)
+    os.truncate(path, recs[-1][0] + 12 + 3)  # mid-payload of the last
+    assert tail_state(wal_dir) == TAIL_TORN
+    records, ts = read_all_classified(wal_dir)
+    assert ts == TAIL_TORN
+    assert len(records) == len(recs) - 2  # seed + torn record excluded
+    assert tail_state(wal_dir) == TAIL_CLEAN  # repair truncated it
+
+
+def test_tail_classifier_header_torn(tmp_path):
+    """A tail shorter than one record header is torn, not clean."""
+    wal_dir = _fresh_wal(tmp_path)
+    path = _seg_path(wal_dir)
+    os.truncate(path, segment_records(path)[-1][0] + 7)
+    assert tail_state(wal_dir) == TAIL_TORN
+
+
+def test_tail_classifier_boundary_cut_is_clean(tmp_path):
+    """Whole records sheared off at an exact boundary leave a valid
+    chain: classified clean — this is exactly why the durability
+    watermark exists (only it can catch a boundary-exact loss)."""
+    wal_dir = _fresh_wal(tmp_path)
+    path = _seg_path(wal_dir)
+    os.truncate(path, segment_records(path)[-1][0])
+    assert tail_state(wal_dir) == TAIL_CLEAN
+
+
+def test_tail_classifier_corruption(tmp_path):
+    """A COMPLETE record failing its crc (no zero sectors) is damage,
+    never a repairable tear."""
+    wal_dir = _fresh_wal(tmp_path)
+    path = _seg_path(wal_dir)
+    recs = segment_records(path)
+    with open(path, "r+b") as f:
+        f.seek(recs[2][0] + 12)
+        b = f.read(1)
+        f.seek(recs[2][0] + 12)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert tail_state(wal_dir) == TAIL_CORRUPT
+
+
+# -- fenced boot + auto-lift (deterministic; shares the chaos config) ----------
+
+
+@pytest.mark.chaos
+def test_torn_acked_tail_boots_fenced_then_heals(tmp_path):
+    """Tear an fsync'd acked entry mid-record: the restarted member
+    must boot FENCED for that group (watermark above the recovered
+    tail, tail classified torn), refuse to campaign while fenced,
+    re-converge from the survivors, auto-lift, and end the episode at
+    STRICT parity — the full 3-checker close plus a clean invariant
+    sweep, no allow_lag."""
+    h = ChaosHarness(str(tmp_path), seed=4242, spec=FaultSpec(),
+                     num_members=R, num_groups=G, cfg=CFG)
+    obs = LeaderObserver(h.alive)
+    try:
+        h.wait_leaders()
+        obs.start()
+        for g in range(G):
+            assert h.put(g, b"k-%d" % g, b"v-%d" % g), f"put g{g}"
+        h.crash(3)
+        chop, torn_g = h.torn_acked_tail(3)
+        assert chop > 0 and torn_g >= 0, "no acked entry record to tear"
+
+        m = h.restart(3)
+        hl = m.health()
+        assert hl["fence_enabled"]
+        assert hl["wal_tail"] == "torn"
+        assert torn_g in hl["fenced_groups"], hl
+        assert hl["catchup_gap"][torn_g] >= 1
+
+        # The fence suppresses campaigning on-device: hammer the torn
+        # group with explicit campaign nudges and verify the damaged
+        # member never claims leadership while fenced (survivors keep
+        # the group led).
+        deadline = time.monotonic() + 0.6
+        while time.monotonic() < deadline:
+            if m._fenced[torn_g]:
+                m.campaign(np.array([torn_g]))
+                assert not m.is_leader(torn_g), (
+                    "fenced member won an election")
+            time.sleep(0.05)
+
+        # Traffic re-replicates the torn-away suffix (append → reject →
+        # backtrack → resend); the fence lifts once the durable log is
+        # back at the watermark.
+        h.touch_all_groups()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and m._fenced.any():
+            time.sleep(0.05)
+        assert not m._fenced.any(), (
+            f"fence never lifted: {m.health()}")
+        assert m.health()["fenced_groups"] == []
+
+        # STRICT parity across all three checkers — the contract this
+        # PR restores for torn-tail episodes (no allow_lag).
+        h.plan.quiesce()
+        run_invariant_checks(h, obs, expect_members=R)
+    finally:
+        obs.stop()
+        h.stop()
+
+
+@pytest.mark.chaos
+def test_clean_restart_never_fences(tmp_path):
+    """Control: an orderly crash/restart with NO tear must boot with a
+    clean tail and zero fenced groups — the fence must not false-fire
+    on the benign path (watermark records replay ahead of the entries
+    they cover)."""
+    h = ChaosHarness(str(tmp_path), seed=4243, spec=FaultSpec(),
+                     num_members=R, num_groups=G, cfg=CFG)
+    try:
+        h.wait_leaders()
+        for g in range(G):
+            assert h.put(g, b"c-%d" % g, b"w-%d" % g)
+        h.crash(2)
+        m = h.restart(2)
+        hl = m.health()
+        assert hl["wal_tail"] == "clean"
+        assert hl["fenced_groups"] == [], hl
+        h.wait_leaders()
+        run_invariant_checks(h, None, expect_members=R)
+    finally:
+        h.stop()

@@ -51,8 +51,22 @@ Run (fails with a diagnostic dump when the wedge reproduces; ~10-30%
 of attempts on a loaded CPU):
 
     JAX_PLATFORMS=cpu python tools/repro_progress_wedge.py
+
+--torn-acked (ISSUE 5): deterministic driver for the OTHER torn-tail
+failure — the out-of-contract divergence itself. It commits writes on a
+2/3 quorum with the third member partitioned, crashes that quorum,
+tears one member's fsync'd acked entry mid-record, then lets the torn
+member campaign against the stale third member. With the durability
+fence DISABLED (the default for this mode — the point is keeping the
+pre-fix failure demonstrable), the torn member wins and the strict
+checkers report the divergence; with --fence the member boots fenced,
+never wins, and the strict checkers pass:
+
+    JAX_PLATFORMS=cpu python tools/repro_progress_wedge.py --torn-acked
+    JAX_PLATFORMS=cpu python tools/repro_progress_wedge.py --torn-acked --fence
 """
 
+import argparse
 import sys
 import tempfile
 import time
@@ -63,6 +77,7 @@ import numpy as np  # noqa: E402
 
 from etcd_tpu.batched.faults import ChaosHarness, FaultSpec  # noqa: E402
 from etcd_tpu.functional import multiraft_hash_check  # noqa: E402
+from etcd_tpu.functional.checker import committed_never_lost  # noqa: E402
 
 
 def main(attempts: int = 10, base_seed: int = 424242) -> int:
@@ -121,5 +136,110 @@ def main(attempts: int = 10, base_seed: int = 424242) -> int:
     return 0
 
 
+def torn_acked(fence: bool, seed: int = 31337,
+               groups: int = 4) -> int:
+    """Reproduce (fence=False) or prove healed (fence=True) the
+    torn-ACKED-bytes divergence. Exit 0 = the mode's expectation held:
+    divergence demonstrated without the fence, strict parity with it."""
+    d = tempfile.mkdtemp(prefix="torn-acked-")
+    h = ChaosHarness(d, seed=seed, spec=FaultSpec(), num_members=3,
+                     num_groups=groups, transport="inproc", fence=fence)
+    try:
+        h.wait_leaders()
+        # Park leadership of every group on member 1, then cut member 3
+        # off: the coming writes commit on the {1, 2} quorum only.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            moved = 0
+            for g in range(groups):
+                lead = h.members[1].leader_of(g)
+                if lead and lead != 1:
+                    h.members[lead].transfer_leader(g, 1)
+                    moved += 1
+            if moved == 0 and all(
+                    h.members[1].is_leader(g) for g in range(groups)):
+                break
+            time.sleep(0.2)
+        h.plan.isolate_member(3, h.members.keys())
+        for g in range(groups):
+            assert h.put(g, b"acked-%d" % g, b"v-%d" % g, timeout=15.0), g
+        # Crash the whole committing quorum; destroy member 2's fsync'd
+        # acked tail mid-record. Member 3 never saw the writes, member 2
+        # no longer durably holds them — only member 1 does.
+        h.crash(1)
+        h.crash(2)
+        chop, torn_g = h.torn_acked_tail(2)
+        assert chop > 0, "no acked entry record in member 2's tail"
+        h.plan.heal_all()
+        # Restart the torn member FIRST and let it campaign against the
+        # stale member 3 (member 1 — the only intact holder — stays
+        # down, so {2, 3} is the electing quorum).
+        m2 = h.restart(2)
+        fenced_at_boot = int(np.count_nonzero(m2._fenced))
+        print(f"member 2 rebooted: tail={m2.health()['wal_tail']} "
+              f"fenced_groups={fenced_at_boot} (torn group {torn_g})")
+        deadline = time.monotonic() + 20.0
+        won = 0
+        while time.monotonic() < deadline:
+            m2.campaign(np.arange(groups))
+            won = sum(m2.is_leader(g) for g in range(groups))
+            if fence and won == 0 and time.monotonic() > deadline - 15.0:
+                break  # fenced: campaigns stay suppressed
+            if not fence and won == groups:
+                break
+            time.sleep(0.2)
+        print(f"member 2 leads {won}/{groups} group(s) "
+              f"({'fence ON' if fence else 'fence OFF'})")
+        h.restart(1)
+        h.wait_leaders()
+        h.touch_all_groups()
+        h.plan.quiesce()
+        try:
+            multiraft_hash_check(h.alive(), timeout=30.0)
+            committed_never_lost(h.alive(), h.acked, timeout=20.0,
+                                 history=h.acked_history)
+            diverged = False
+        except AssertionError as e:
+            diverged = True
+            print(f"strict checkers FAILED: {e}")
+        if fence:
+            if diverged:
+                print("UNEXPECTED: divergence despite the fence")
+                return 1
+            print("fence held: torn member never campaigned, strict "
+                  "parity restored")
+            return 0
+        if not diverged:
+            print("no divergence this run — the torn entries were "
+                  "re-replicated before an election landed; re-run "
+                  "or raise --groups")
+            return 1
+        print("pre-fix divergence reproduced: the torn member's "
+              "shortened log displaced committed-and-applied state "
+              "(run with --fence to see the ISSUE 5 fence close it)")
+        return 0
+    finally:
+        h.stop()
+
+
+def _cli(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--torn-acked", action="store_true",
+                   help="drive the torn-ACKED-bytes divergence instead "
+                        "of the (fixed) progress wedge")
+    p.add_argument("--fence", action="store_true",
+                   help="with --torn-acked: enable the durability "
+                        "fence (expect strict parity instead of the "
+                        "divergence)")
+    p.add_argument("--attempts", type=int, default=10)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--groups", type=int, default=4)
+    a = p.parse_args(argv)
+    if a.torn_acked:
+        return torn_acked(a.fence, seed=a.seed or 31337,
+                          groups=a.groups)
+    return main(attempts=a.attempts, base_seed=a.seed or 424242)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_cli())
