@@ -531,7 +531,12 @@ class ChaosHarness:
         group_of_the_torn_entry); (0, -1) when the tail segment holds
         no entry records (nothing acked to tear)."""
         from ..native.walog import segment_records
-        from .hosting import RT_ENTRY
+        from .hosting import (
+            RT_ENTRY,
+            RT_ENTRY_BATCH,
+            WAL_ENT_DTYPE,
+            _unpack_batch,
+        )
 
         m = self.members[mid]
         assert m._stopped.is_set(), "torn_acked_tail needs a crashed member"
@@ -540,13 +545,21 @@ class ChaosHarness:
                       if f.endswith(".wal"))
         assert segs, "no WAL segments to tear"
         path = os.path.join(wal_dir, segs[-1])
-        recs = [r for r in segment_records(path) if r[1] == RT_ENTRY]
+        recs = [r for r in segment_records(path)
+                if r[1] in (RT_ENTRY, RT_ENTRY_BATCH)]
         if not recs:
             return 0, -1
-        off, _rt, _ln, padded = recs[-1]
+        off, rt, ln, padded = recs[-1]
         with open(path, "rb") as f:
             f.seek(off + 12)  # record header: u32 len | u8 type | pad | crc
-            group = int.from_bytes(f.read(4), "little")
+            body = f.read(ln)
+        if rt == RT_ENTRY_BATCH:
+            # A mid-record tear destroys the WHOLE batch record; report
+            # the group of its last entry (the deepest demanded index —
+            # any entry-carrying group in the batch boots fenced).
+            group = int(_unpack_batch(body, WAL_ENT_DTYPE)["group"][-1])
+        else:
+            group = int.from_bytes(body[:4], "little")
         size = os.path.getsize(path)
         cut = off + 12 + 5  # mid-payload: header survives, bytes don't
         os.truncate(path, cut)

@@ -84,6 +84,37 @@ RT_SNAPSHOT = 3  # same layout as RT_ENTRY; data = app snapshot
 # _replay can tell "acked bytes lost" (fence the group) from "crash
 # before the write" (nothing to do).
 RT_WATERMARK = 4  # group:u32 last:u64 last_term:u64 commit:u64
+# One record for a whole Ready's entries, numpy-serialized:
+# u32 count | count * WAL_ENT_DTYPE headers | payloads back to back.
+# Replaces per-entry RT_ENTRY records on the write path (RT_ENTRY still
+# replays for logs written before the batch format); the watermark
+# ordering contract is unchanged — a tear anywhere inside the batch
+# record destroys it wholesale, and the preceding RT_WATERMARK records
+# still demand every entry it carried.
+RT_ENTRY_BATCH = 5
+# Batched twins of RT_HARDSTATE / RT_WATERMARK, numpy-serialized:
+# u32 count | count * WAL_*_DTYPE rows. A steady round writes hundreds
+# of hardstate/watermark records per member; one structured-array
+# tobytes replaces that many struct.pack + ctypes append calls.
+RT_HS_BATCH = 6
+RT_WM_BATCH = 7
+
+# Per-entry header inside an RT_ENTRY_BATCH record (packed, 25 bytes —
+# the same fields as RT_ENTRY's "<IQQBI" header, SoA-serializable).
+WAL_ENT_DTYPE = np.dtype([
+    ("group", "<u4"), ("index", "<u8"), ("term", "<u8"),
+    ("etype", "<u1"), ("len", "<u4"),
+])
+# Rows of RT_HS_BATCH / RT_WM_BATCH (field-compatible with the single
+# records' "<IQIQ" / "<IQQQ" layouts).
+WAL_HS_DTYPE = np.dtype([
+    ("group", "<u4"), ("term", "<u8"), ("vote", "<u4"),
+    ("commit", "<u8"),
+])
+WAL_WM_DTYPE = np.dtype([
+    ("group", "<u4"), ("last", "<u8"), ("last_term", "<u8"),
+    ("commit", "<u8"),
+])
 
 
 def _pack_entry(group: int, index: int, term: int, data: bytes,
@@ -95,6 +126,49 @@ def _unpack_entry(b: bytes) -> Tuple[int, int, int, bytes, int]:
     g, i, t, et, ln = struct.unpack_from("<IQQBI", b)
     off = struct.calcsize("<IQQBI")
     return g, i, t, b[off:off + ln], et
+
+
+def _pack_rows(dtype: np.dtype, cols: Dict[str, object]) -> bytes:
+    """Count-prefixed structured rows — the one serializer behind every
+    RT_*_BATCH record (the replay side is _unpack_batch)."""
+    n = len(next(iter(cols.values())))
+    rec = np.empty(n, dtype)
+    for f, v in cols.items():
+        rec[f] = v
+    return struct.pack("<I", n) + rec.tobytes()
+
+
+def _pack_entry_batch(eb) -> bytes:
+    """Serialize an EntryBatch as one WAL record: one numpy header
+    array + one payload join — no per-entry struct.pack."""
+    hdr = _pack_rows(WAL_ENT_DTYPE, {
+        "group": eb.rows, "index": eb.idx, "term": eb.term,
+        "etype": eb.etype,
+        "len": np.fromiter(map(len, eb.datas), np.uint32, len(eb.datas)),
+    })
+    return hdr + b"".join(eb.datas)
+
+
+def _iter_entry_batch(b: bytes):
+    """Yield (group, index, term, data, etype) from an RT_ENTRY_BATCH
+    record (replay path)."""
+    (n,) = struct.unpack_from("<I", b)
+    hdr = np.frombuffer(b, WAL_ENT_DTYPE, count=n, offset=4)
+    off = 4 + n * WAL_ENT_DTYPE.itemsize
+    lens = hdr["len"].tolist()
+    for g, i, t, et, ln in zip(hdr["group"].tolist(),
+                               hdr["index"].tolist(),
+                               hdr["term"].tolist(),
+                               hdr["etype"].tolist(), lens):
+        yield g, i, t, b[off:off + ln], et
+        off += ln
+
+
+def _unpack_batch(b: bytes, dtype: np.dtype) -> np.ndarray:
+    """Header-counted structured rows of an RT_HS_BATCH / RT_WM_BATCH
+    record."""
+    (n,) = struct.unpack_from("<I", b)
+    return np.frombuffer(b, dtype, count=n, offset=4)
 
 
 def _pack_hs(group: int, term: int, vote: int, commit: int) -> bytes:
@@ -279,9 +353,15 @@ class MultiRaftMember:
             mid = str(member_id)
             self._h_fsync = wal_fsync_histogram().labels(mid)
             ph = round_phase_histogram()
+            # round/wal/apply/send are member-pipeline phases; stage/
+            # extract/collect split the round's host-side Python (inbox
+            # staging, post-round extraction, outbound block assembly)
+            # so the BENCH_NOTES phase breakdown is reproducible from
+            # metrics alone (dump_metrics --admin).
             self._h_phase = {
-                p: ph.labels(mid, p) for p in ("round", "wal", "apply",
-                                               "send")
+                p: ph.labels(mid, p)
+                for p in ("round", "wal", "apply", "send",
+                          "stage", "extract", "collect")
             }
         if restore:
             for row, rr in restore.items():
@@ -345,6 +425,12 @@ class MultiRaftMember:
                 while lst and lst[-1][0] >= i:
                     lst.pop()  # WAL truncate-and-append semantics
                 lst.append((i, t, d, et))
+            elif rtype == RT_ENTRY_BATCH:
+                for g, i, t, d, et in _iter_entry_batch(data):
+                    lst = ents[g]
+                    while lst and lst[-1][0] >= i:
+                        lst.pop()  # truncate-and-append per entry
+                    lst.append((i, t, d, et))
             elif rtype == RT_SNAPSHOT:
                 g, i, t, d, _et = _unpack_snap(data)
                 snaps[g] = (i, t, d)
@@ -356,6 +442,20 @@ class MultiRaftMember:
                 # false-fence a healthy member.
                 g, wl, wt, wc = _unpack_wm(data)
                 wms[g] = (wl, wt, wc)
+            elif rtype == RT_HS_BATCH:
+                hs = _unpack_batch(data, WAL_HS_DTYPE)
+                for g, term, vote, commit in zip(
+                        hs["group"].tolist(), hs["term"].tolist(),
+                        hs["vote"].tolist(), hs["commit"].tolist()):
+                    rr = rows[g]
+                    rr.term, rr.vote, rr.commit = term, vote, commit
+            elif rtype == RT_WM_BATCH:
+                wmb = _unpack_batch(data, WAL_WM_DTYPE)
+                for g, wl, wt, wc in zip(
+                        wmb["group"].tolist(), wmb["last"].tolist(),
+                        wmb["last_term"].tolist(),
+                        wmb["commit"].tolist()):
+                    wms[g] = (wl, wt, wc)
         restore: Dict[int, RowRestore] = {}
         for g in set(rows) | set(ents) | set(snaps):
             rr = rows[g]
@@ -513,6 +613,9 @@ class MultiRaftMember:
         self.stats["round_s"] += dt
         if self._h_phase is not None:
             self._h_phase["round"].observe(dt)
+            pl = self.rn.phase_last
+            for p in ("stage", "extract", "collect"):
+                self._h_phase[p].observe(pl[p])
         if self._drainer is not None:
             # Bounded: backpressure on the round — but never block
             # forever on a stopped/dead drain worker (see _drain_loop's
@@ -560,11 +663,23 @@ class MultiRaftMember:
                     ent = _wm_row(row)
                     if commit > ent[2]:
                         ent[2] = commit
-                for row, i, t, _d, _et in rd.entries:
-                    ent = _wm_row(row)
-                    ent[0], ent[1], ent[3] = i, t, 1
+                eb = rd.entries
+                if len(eb):
+                    # Last entry per row IS the row's new durable
+                    # (last, last_term): entries are row-ascending with
+                    # ascending indexes, so segment boundaries give the
+                    # per-row finals without a per-entry pass.
+                    rows_a = eb.rows
+                    ends = np.nonzero(np.diff(rows_a))[0]
+                    lasts = np.append(ends, len(rows_a) - 1)
+                    for j in lasts.tolist():
+                        ent = _wm_row(int(rows_a[j]))
+                        ent[0] = int(eb.idx[j])
+                        ent[1] = int(eb.term[j])
+                        ent[3] = 1
                 must_sync |= rd.must_sync
             if self.fence_enabled:
+                wm_rows: List[Tuple[int, int, int, int]] = []
                 for row in sorted(wm):
                     last, lterm, commit, has_ents = wm[row]
                     if not has_ents:
@@ -577,14 +692,23 @@ class MultiRaftMember:
                         lterm = int(self._wm_term[row])
                     if self._fenced[row]:
                         commit = max(commit, int(self._wm_commit[row]))
-                    self.wal.append(
-                        RT_WATERMARK, _pack_wm(row, last, lterm, commit))
+                    wm_rows.append((row, last, lterm, commit))
+                if wm_rows:
+                    wma = np.array(wm_rows, np.int64)
+                    self.wal.append(RT_WM_BATCH, _pack_rows(
+                        WAL_WM_DTYPE,
+                        {"group": wma[:, 0], "last": wma[:, 1],
+                         "last_term": wma[:, 2], "commit": wma[:, 3]}))
             for rd in batch:
-                for row, term, vote, commit in rd.hardstates:
+                if rd.hardstates:
+                    hsa = np.array(rd.hardstates, np.int64)
+                    self.wal.append(RT_HS_BATCH, _pack_rows(
+                        WAL_HS_DTYPE,
+                        {"group": hsa[:, 0], "term": hsa[:, 1],
+                         "vote": hsa[:, 2], "commit": hsa[:, 3]}))
+                if len(rd.entries):
                     self.wal.append(
-                        RT_HARDSTATE, _pack_hs(row, term, vote, commit))
-                for row, i, t, d, et in rd.entries:
-                    self.wal.append(RT_ENTRY, _pack_entry(row, i, t, d, et))
+                        RT_ENTRY_BATCH, _pack_entry_batch(rd.entries))
             if must_sync:
                 tf = time.perf_counter()
                 self.wal.flush(sync=True)
@@ -1243,26 +1367,25 @@ class TCPRouter:
         would kill the receiver's stream every round, forever)."""
         import queue as _q
 
-        from .msgblock import MsgBlock
-
-        subs = blk.split_by_target()
+        rec = blk.rec
+        tos = np.unique(rec["to"]).tolist()
         queues: Dict[int, "_q.Queue"] = {}
         with self._lock:
             if self._stopped.is_set():
                 return
-            for to in subs:
-                ent = self._ensure_peer_locked(to)
+            for to in tos:
+                ent = self._ensure_peer_locked(int(to))
                 if ent is not None:
-                    queues[to] = ent[0]
+                    queues[int(to)] = ent[0]
 
         def enqueue(q2, sub, prio) -> None:
             body = sub.to_bytes()
             if len(body) + 8 > self._max_frame and len(sub) > 1:
+                # Contiguous record halves keep the entry arena as
+                # pure slices (no gather on the chunking path).
                 half = len(sub) // 2
-                enqueue(q2, MsgBlock(sub.rec[:half], sub.ents[:half]),
-                        prio)
-                enqueue(q2, MsgBlock(sub.rec[half:], sub.ents[half:]),
-                        prio)
+                enqueue(q2, sub.take(slice(0, half)), prio)
+                enqueue(q2, sub.take(slice(half, None)), prio)
                 return
             if len(body) + 8 > self._max_frame:
                 # single unsendable record: drop (raft retries)
@@ -1275,24 +1398,28 @@ class TCPRouter:
             except _q.Full:  # drop, never block the round loop
                 self._count("queue_full_drop", len(sub))
 
-        for to, sub in subs.items():
+        # One gather per shipped half, straight off the round block:
+        # target and liveness/bulk masks combine BEFORE take(), so the
+        # per-target sub-block is never materialized twice.
+        has_ents = rec["n_ents"] > 0
+        any_ents = bool(has_ents.any())
+        for to in tos:
+            to = int(to)
+            tmask = rec["to"] == to
             q2 = queues.get(to)
             if q2 is None:
-                self._count("no_route", len(sub))
+                self._count("no_route", int(tmask.sum()))
                 continue
-            has_ents = sub.rec["n_ents"] > 0
-            if has_ents.any():
-                live = MsgBlock(
-                    sub.rec[~has_ents],
-                    [e for e, b in zip(sub.ents, has_ents) if not b])
-                bulk = MsgBlock(
-                    sub.rec[has_ents],
-                    [e for e, b in zip(sub.ents, has_ents) if b])
+            if any_ents and (tmask & has_ents).any():
+                live = blk.take(tmask & ~has_ents)
+                bulk = blk.take(tmask & has_ents)
                 if len(live):
                     enqueue(q2, live, self.PRIO_LIVE)
                 enqueue(q2, bulk, self.PRIO_BULK)
+            elif len(tos) == 1:
+                enqueue(q2, blk, self.PRIO_LIVE)
             else:
-                enqueue(q2, sub, self.PRIO_LIVE)
+                enqueue(q2, blk.take(tmask), self.PRIO_LIVE)
 
     def _ensure_peer_locked(self, to: int):
         """Resolve or lazily create the (queue, sender) for a peer.

@@ -4,19 +4,38 @@ At G=1024 a single round emits ~2*G messages per member; materializing
 each as a Python ``Message`` (collect -> encode -> socket -> decode ->
 per-message lock + stage) costs ~100us apiece, which is the entire
 round budget — the hosted service rate was gated on it. Messages
-instead stay as one packed numpy record array end-to-end: sliced
-straight out of the device outbox, shipped as ONE frame per peer per
-round, and scattered into the next round's inbox with vectorized
-first-wins merging.
+instead stay as one packed numpy record array end-to-end: view-cast
+straight out of the device outbox (step.pack_outbox emits records
+pre-packed at wire widths), shipped as ONE frame per peer per round,
+and scattered into the next round's inbox with vectorized first-wins
+merging.
 
-Since round 5 the block also carries MsgApp WITH entries: each record
-has an ``n_ents`` count and the frame a trailing entries section
-(entry indexes are implicit — MsgApp entries are contiguous from
-``index+1``). Only MsgSnap (app-state payloads attached by the hosting
-layer at send time) takes the per-message object path. This is the
-batched analog of the reference's two rafthttp channels
-(ref: server/etcdserver/api/rafthttp/peer.go:337-349), with the bulk
-append stream vectorized too.
+Entry payloads ride a **flat arena**, not per-record Python lists: one
+``ent_term``/``ent_etype``/``ent_len`` SoA plus a single contiguous
+payload buffer, with per-record extents derived from the cumsum of
+``n_ents``. Every block operation — codec, split, validate, merge —
+is offset math and bulk numpy slices; no per-entry ``struct.pack``
+loops anywhere on the hot path. Entry indexes are implicit (MsgApp
+entries are contiguous from ``index+1``). Only MsgSnap (app-state
+payloads attached by the hosting layer at send time) takes the
+per-message object path. This is the batched analog of the reference's
+two rafthttp channels (ref: server/etcdserver/api/rafthttp/peer.go:
+337-349), with the bulk append stream vectorized too.
+
+Wire format (version 2, one frame)::
+
+    u1  version (= WIRE_VERSION)
+    u4  n_recs
+    n_recs * REC_DTYPE records                 (36 B each)
+    u4  n_ents  (must equal sum of rec.n_ents)
+    n_ents * ENT_DTYPE entry headers           (9 B each)
+    payload bytes (sum of ent_len)
+
+A mismatched version byte, a length that disagrees with the counted
+sections, or trailing bytes all raise ``ValueError`` — the transport
+counts ``recv_corrupt`` and drops the connection, so a mixed-version
+pod degrades to message loss (which raft tolerates) instead of
+misparsing frames.
 """
 
 from __future__ import annotations
@@ -27,25 +46,19 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .step import (
-    KIND_APP,
-    KIND_APP_RESP,
-    KIND_HB,
-    KIND_HB_RESP,
-    KIND_VOTE,
-    KIND_VOTE_RESP,
+    LANE_OF,
+    NUM_WIRE_TYPES,
     T_APP,
-    T_APP_RESP,
-    T_HB,
-    T_HB_RESP,
-    T_PREVOTE,
-    T_PREVOTE_RESP,
     T_SNAP,
-    T_TIMEOUT_NOW,
-    T_VOTE,
-    T_VOTE_RESP,
 )
 
-# One wire record per message; packed little-endian, 34 bytes.
+# Bump on any layout change: a frame whose leading byte disagrees is
+# rejected at decode (never misparsed).
+WIRE_VERSION = 2
+
+# One wire record per message; packed little-endian, 36 bytes = 9 u32
+# words — exactly the rows step.pack_outbox emits, so device outbox ->
+# wire records is a view-cast, not a gather.
 REC_DTYPE = np.dtype([
     ("row", "<u4"),          # receiver-side row (group id in hosting)
     ("to", "<u1"),           # target slot + 1 (member id)
@@ -53,9 +66,10 @@ REC_DTYPE = np.dtype([
     ("lane", "<u1"),         # inbox lane (KIND_*)
     ("type", "<u1"),         # wire type (T_*)
     ("reject", "<u1"),
-    ("n_ents", "<u1"),       # entries in the trailing section (T_APP);
+    ("n_ents", "<u1"),       # entries in the arena section (T_APP);
     # one byte caps E at 255 — BatchedConfig.validate() enforces
     # max_ents_per_msg <= state.MAX_WIRE_ENTS so a config can't wrap it
+    ("pad", "<u2"),          # word alignment; always 0 on the wire
 
     ("term", "<u4"),
     ("log_term", "<u4"),
@@ -64,91 +78,228 @@ REC_DTYPE = np.dtype([
     ("reject_hint", "<u4"),
     ("ctx", "<u4"),          # 4-byte context word
 ])
+REC_SIZE = REC_DTYPE.itemsize
+assert REC_SIZE == 36 and REC_SIZE % 4 == 0
 
 # Per-entry wire header in the entries section: term, etype, data len.
-_ENT_HDR = struct.Struct("<IBI")
+ENT_DTYPE = np.dtype([("term", "<u4"), ("etype", "<u1"), ("len", "<u4")])
+ENT_SIZE = ENT_DTYPE.itemsize
+_HEAD = struct.Struct("<BI")
+_U4 = struct.Struct("<I")
 
 # One entry as carried by a block: (term, etype, data).
 BlockEnt = Tuple[int, int, bytes]
 
-# Wire type -> inbox lane, as a lookup table for vectorized use
-# (mirrors rawnode._LANE).
-_MAX_T = 32
-LANE_OF = np.full(_MAX_T, -1, np.int8)
-for _t, _lane in (
-    (T_VOTE, KIND_VOTE), (T_PREVOTE, KIND_VOTE),
-    (T_APP, KIND_APP), (T_SNAP, KIND_APP),
-    (T_HB, KIND_HB), (T_TIMEOUT_NOW, KIND_HB),
-    (T_VOTE_RESP, KIND_VOTE_RESP), (T_PREVOTE_RESP, KIND_VOTE_RESP),
-    (T_APP_RESP, KIND_APP_RESP),
-    (T_HB_RESP, KIND_HB_RESP),
-):
-    LANE_OF[_t] = _lane
+_MAX_T = NUM_WIRE_TYPES  # compat alias (LANE_OF's index range)
+
+_EMPTY_U4 = np.empty(0, "<u4")
+_EMPTY_U1 = np.empty(0, "<u1")
+_EMPTY_I8 = np.empty(0, np.int64)
+
+
+def ragged_ranges(starts, lens) -> np.ndarray:
+    """Concatenated ``arange(s, s+l)`` for each (start, len) pair — the
+    ragged-gather index builder (one repeat + one arange, no Python
+    loop)."""
+    lens = np.asarray(lens, np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return _EMPTY_I8
+    base = np.asarray(starts, np.int64) - (np.cumsum(lens) - lens)
+    return np.repeat(base, lens) + np.arange(total, dtype=np.int64)
 
 
 class MsgBlock:
-    """A batch of messages as one structured array plus, for records
-    with ``n_ents > 0``, their entry payloads (``ents[i]`` is the
-    entry list of ``rec[i]`` or None)."""
+    """A batch of messages as one structured record array plus a flat
+    entry arena.
 
-    __slots__ = ("rec", "ents")
+    ``ent_term``/``ent_etype``/``ent_len`` hold the entries of every
+    record back to back in record order; ``payload`` is their data
+    bytes, one contiguous buffer. Record i's entries occupy arena rows
+    ``[starts[i], starts[i] + ent_counts[i])`` where ``starts`` is the
+    exclusive cumsum of ``ent_counts``. For wire-parsed and
+    collect-built blocks ``ent_counts == rec["n_ents"]``; a hand-built
+    block whose arena disagrees with its counts is dropped by
+    ``validate_block`` (a frame cannot lie — from_bytes enforces the
+    totals)."""
+
+    __slots__ = ("rec", "ent_term", "ent_etype", "ent_len", "payload",
+                 "ent_counts", "_starts", "_pstarts")
 
     def __init__(self, rec: np.ndarray,
-                 ents: Optional[List[Optional[List[BlockEnt]]]] = None
-                 ) -> None:
+                 ents: Optional[List[Optional[List[BlockEnt]]]] = None,
+                 *, ent_term: Optional[np.ndarray] = None,
+                 ent_etype: Optional[np.ndarray] = None,
+                 ent_len: Optional[np.ndarray] = None,
+                 payload: bytes = b"",
+                 ent_counts: Optional[np.ndarray] = None) -> None:
         self.rec = rec
-        self.ents = ents if ents is not None else [None] * len(rec)
+        self._starts = None
+        self._pstarts = None
+        if ents is not None:
+            # Compat constructor from per-record entry lists (tests,
+            # hand-built blocks); the hot paths build arenas directly.
+            counts = np.zeros(len(rec), np.int64)
+            terms: List[int] = []
+            etys: List[int] = []
+            lens: List[int] = []
+            parts: List[bytes] = []
+            for i, lst in enumerate(ents):
+                if not lst:
+                    continue
+                counts[i] = len(lst)
+                for t, ety, d in lst:
+                    terms.append(t)
+                    etys.append(ety)
+                    lens.append(len(d))
+                    parts.append(d)
+            self.ent_term = np.asarray(terms, "<u4")
+            self.ent_etype = np.asarray(etys, "<u1")
+            self.ent_len = np.asarray(lens, "<u4")
+            self.payload = b"".join(parts)
+            self.ent_counts = counts
+            return
+        self.ent_term = ent_term if ent_term is not None else _EMPTY_U4
+        self.ent_etype = ent_etype if ent_etype is not None else _EMPTY_U1
+        self.ent_len = ent_len if ent_len is not None else _EMPTY_U4
+        self.payload = payload
+        if ent_counts is not None:
+            self.ent_counts = np.asarray(ent_counts, np.int64)
+        elif len(self.ent_term):
+            self.ent_counts = rec["n_ents"].astype(np.int64)
+        else:
+            self.ent_counts = np.zeros(len(rec), np.int64)
 
     def __len__(self) -> int:
         return len(self.rec)
 
+    # -- arena offsets ---------------------------------------------------------
+
+    def _ent_starts(self) -> np.ndarray:
+        """Per-record exclusive cumsum of ent_counts (arena row of each
+        record's first entry)."""
+        if self._starts is None:
+            self._starts = np.cumsum(self.ent_counts) - self.ent_counts
+        return self._starts
+
+    def _pay_starts(self) -> np.ndarray:
+        """Per-entry exclusive cumsum of ent_len (payload byte offset
+        of each entry's data)."""
+        if self._pstarts is None:
+            ln = self.ent_len.astype(np.int64)
+            self._pstarts = np.cumsum(ln) - ln
+        return self._pstarts
+
+    # -- compat accessors ------------------------------------------------------
+
+    def entry_list(self, i: int) -> Optional[List[BlockEnt]]:
+        """Record i's entries as (term, etype, data) tuples, or None —
+        the object-path shape (low-volume consumers only)."""
+        c = int(self.ent_counts[i])
+        if c == 0:
+            return None
+        s = int(self._ent_starts()[i])
+        ps = self._pay_starts()
+        out: List[BlockEnt] = []
+        for j in range(s, s + c):
+            a = int(ps[j])
+            out.append((int(self.ent_term[j]), int(self.ent_etype[j]),
+                        bytes(self.payload[a:a + int(self.ent_len[j])])))
+        return out
+
+    @property
+    def ents(self) -> List[Optional[List[BlockEnt]]]:
+        """Materialized per-record entry lists (compat/debug only —
+        never on the hot path)."""
+        return [self.entry_list(i) for i in range(len(self.rec))]
+
+    # -- codec -----------------------------------------------------------------
+
     def to_bytes(self) -> bytes:
-        parts = [struct.pack("<I", len(self.rec)), self.rec.tobytes()]
-        for i in np.nonzero(self.rec["n_ents"])[0]:
-            for term, etype, data in self.ents[i]:
-                parts.append(_ENT_HDR.pack(term, etype, len(data)))
-                parts.append(data)
-        return b"".join(parts)
+        ne = len(self.ent_term)
+        hdr = np.empty(ne, ENT_DTYPE)
+        hdr["term"] = self.ent_term
+        hdr["etype"] = self.ent_etype
+        hdr["len"] = self.ent_len
+        return b"".join((
+            _HEAD.pack(WIRE_VERSION, len(self.rec)),
+            self.rec.tobytes(),
+            _U4.pack(ne),
+            hdr.tobytes(),
+            self.payload,
+        ))
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "MsgBlock":
-        if len(b) < 4:
+        if len(b) < _HEAD.size:
             raise ValueError("block frame too short")
-        (n,) = struct.unpack_from("<I", b)
-        off = 4 + n * REC_DTYPE.itemsize
-        if len(b) < off:
+        ver, n = _HEAD.unpack_from(b)
+        if ver != WIRE_VERSION:
             raise ValueError(
-                f"block frame truncated: {len(b)} < {off} for {n} recs")
-        rec = np.frombuffer(b, REC_DTYPE, count=n, offset=4)
-        ents: List[Optional[List[BlockEnt]]] = [None] * n
-        for i in np.nonzero(rec["n_ents"])[0]:
-            lst: List[BlockEnt] = []
-            for _ in range(int(rec["n_ents"][i])):
-                if len(b) < off + _ENT_HDR.size:
-                    raise ValueError("entries section truncated")
-                term, etype, ln = _ENT_HDR.unpack_from(b, off)
-                off += _ENT_HDR.size
-                if len(b) < off + ln:
-                    raise ValueError("entry payload truncated")
-                lst.append((term, etype, b[off:off + ln]))
-                off += ln
-            ents[int(i)] = lst
-        if off != len(b):
+                f"block wire version {ver} != {WIRE_VERSION}")
+        off = _HEAD.size + n * REC_SIZE
+        if len(b) < off + 4:
             raise ValueError(
-                f"block frame has {len(b) - off} trailing bytes")
-        return cls(rec, ents)
+                f"block frame truncated: {len(b)} < {off + 4} "
+                f"for {n} recs")
+        rec = np.frombuffer(b, REC_DTYPE, count=n, offset=_HEAD.size)
+        (ne,) = _U4.unpack_from(b, off)
+        counts = rec["n_ents"].astype(np.int64)
+        if ne != int(counts.sum()):
+            raise ValueError(
+                f"entries section counts {ne} entries, records claim "
+                f"{int(counts.sum())}")
+        hoff = off + 4
+        poff = hoff + ne * ENT_SIZE
+        if len(b) < poff:
+            raise ValueError("entries section truncated")
+        hdr = np.frombuffer(b, ENT_DTYPE, count=ne, offset=hoff)
+        pay_len = int(hdr["len"].astype(np.int64).sum())
+        if len(b) != poff + pay_len:
+            raise ValueError(
+                f"block frame has {len(b) - poff - pay_len} bytes "
+                "beyond the entry payloads")
+        return cls(rec, ent_term=hdr["term"], ent_etype=hdr["etype"],
+                   ent_len=hdr["len"], payload=b[poff:],
+                   ent_counts=counts)
+
+    # -- subset selection ------------------------------------------------------
+
+    def take(self, sel) -> "MsgBlock":
+        """Sub-block of the selected records (bool mask, index array,
+        or slice), entries carried along. A contiguous slice keeps the
+        arena as pure slices; anything else is one ragged gather."""
+        rec = self.rec[sel]
+        cnt = self.ent_counts[sel]
+        tot = int(cnt.sum())
+        if tot == 0:
+            return MsgBlock(rec, ent_counts=cnt)
+        if isinstance(sel, slice) and (sel.step is None or sel.step == 1):
+            st = self._ent_starts()[sel]
+            e0 = int(st[0])
+            e1 = e0 + tot
+            ps = self._pay_starts()
+            p0 = int(ps[e0])
+            p1 = int(ps[e1 - 1]) + int(self.ent_len[e1 - 1])
+            return MsgBlock(
+                rec, ent_term=self.ent_term[e0:e1],
+                ent_etype=self.ent_etype[e0:e1],
+                ent_len=self.ent_len[e0:e1],
+                payload=self.payload[p0:p1], ent_counts=cnt)
+        eidx = ragged_ranges(self._ent_starts()[sel], cnt)
+        lens = self.ent_len[eidx]
+        bidx = ragged_ranges(self._pay_starts()[eidx], lens)
+        pay = np.frombuffer(self.payload, np.uint8)[bidx].tobytes()
+        return MsgBlock(rec, ent_term=self.ent_term[eidx],
+                        ent_etype=self.ent_etype[eidx], ent_len=lens,
+                        payload=pay, ent_counts=cnt)
 
     def split_by_target(self) -> Dict[int, "MsgBlock"]:
         """Partition by target member id (slot+1)."""
-        rec = self.rec
-        out: Dict[int, MsgBlock] = {}
-        for to in np.unique(rec["to"]):
-            mask = rec["to"] == to
-            out[int(to)] = MsgBlock(
-                rec[mask],
-                [e for e, keep in zip(self.ents, mask) if keep],
-            )
-        return out
+        tos = np.unique(self.rec["to"])
+        if len(tos) == 1:
+            return {int(tos[0]): self}
+        return {int(to): self.take(self.rec["to"] == to) for to in tos}
 
 
 def validate_block(blk: "MsgBlock", n_rows: int, num_replicas: int,
@@ -159,13 +310,15 @@ def validate_block(blk: "MsgBlock", n_rows: int, num_replicas: int,
 
     A record is well-formed iff row < n_rows, 1 <= frm <= R,
     lane == LANE_OF[type], n_ents <= max_ents, entries only on T_APP,
-    and never T_SNAP (snapshots carry app state the hosting layer must
+    never T_SNAP (snapshots carry app state the hosting layer must
     restore FIRST; a forged one would fast-forward raft state past
-    entries whose data never arrived). Anything else would index the
-    dense inbox out of range (crashing the member's round loop) or —
-    worse, for frm=0 — wrap to a negative flat index and silently
-    forge a message into a DIFFERENT group's inbox slot.
-    """
+    entries whose data never arrived), and the arena actually backs
+    the claimed entry count (a hand-built block could lie;
+    from_bytes-parsed ones cannot — the totals are enforced at
+    decode). Anything else would index the dense inbox out of range
+    (crashing the member's round loop) or — worse, for frm=0 — wrap to
+    a negative flat index and silently forge a message into a
+    DIFFERENT group's inbox slot."""
     rec = blk.rec
     if len(rec) == 0:
         return blk
@@ -177,19 +330,21 @@ def validate_block(blk: "MsgBlock", n_rows: int, num_replicas: int,
         & (rec["lane"] == LANE_OF[np.minimum(typ, _MAX_T - 1)])
         & (rec["n_ents"] <= max_ents)
         & ((rec["n_ents"] == 0) | (typ == T_APP))
+        & (rec["n_ents"] == blk.ent_counts)
     )
-    # Entries must actually be present for every counted record (a
-    # hand-built block could lie; from_bytes-parsed ones cannot). Only
-    # entry-carrying records need the Python check — the payload-free
-    # majority stays vectorized.
-    for i in np.nonzero(ok & (rec["n_ents"] > 0))[0]:
-        e = blk.ents[i]
-        if e is None or len(e) != int(rec["n_ents"][i]):
-            ok[i] = False
+    # Block-level structural check: ent_counts must be backed by the
+    # arena itself (a hand-built block can claim counts its arrays
+    # don't hold — ent_counts defaults from rec["n_ents"], so the
+    # per-record compare above can't see it). If the totals disagree,
+    # per-record attribution is meaningless: keep only payload-free
+    # records.
+    if (int(blk.ent_counts.sum()) != len(blk.ent_term)
+            or int(blk.ent_len.astype(np.int64).sum())
+            != len(blk.payload)):
+        ok &= rec["n_ents"] == 0
     if ok.all():
         return blk
-    return MsgBlock(rec[ok],
-                    [e for e, keep in zip(blk.ents, ok) if keep])
+    return blk.take(ok)
 
 
 def block_messages(blk: "MsgBlock") -> "list":
@@ -214,32 +369,43 @@ def block_messages(blk: "MsgBlock") -> "list":
         cw = int(rec["ctx"])
         if cw:
             m.context = cw.to_bytes(4, "little")
-        if rec["n_ents"] and blk.ents[i]:
+        ents = blk.entry_list(i) if rec["n_ents"] else None
+        if ents:
             m.entries = [
                 Entry(index=int(rec["index"]) + 1 + j, term=term,
                       data=data, type=EntryType(etype))
-                for j, (term, etype, data) in enumerate(blk.ents[i])
+                for j, (term, etype, data) in enumerate(ents)
             ]
         out.append((int(rec["row"]), m))
     return out
 
 
+def compact_records(words: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """Device-packed word rows -> wire records: one view-cast plus one
+    boolean take. `words` is the [M, REC_WORDS] i32 output of
+    step.pack_outbox (rows are REC_DTYPE bytes); returns the REC_DTYPE
+    records selected by `mask` (a fresh, writable array)."""
+    w = np.ascontiguousarray(words)
+    rec = w.view(REC_DTYPE).reshape(w.shape[0])
+    return rec[mask]
+
+
 def collect_block(out_valid: np.ndarray, out: "object",
                   slots: np.ndarray) -> "tuple[MsgBlock, np.ndarray]":
-    """Slice the block-eligible messages out of a device outbox.
+    """Reference collect: slice the block-eligible messages out of a
+    numpy-materialized outbox with per-field gathers.
 
-    `out` is the numpy-materialized outbox (fields [n, R, K]); returns
-    (block, complex_mask) where complex_mask marks the slots that still
-    need the per-message path (MsgSnap only — its app-state payload is
-    attached by the hosting layer at send time). MsgApp entry payloads
-    are NOT attached here (the arena lives in the caller); records
-    carry n_ents and the caller fills ``block.ents`` in record order.
-    """
+    Kept as the differential twin of the packed path (step.pack_outbox
+    + compact_records, which the hosted hot path uses) and for callers
+    holding an already-materialized outbox. Returns (block,
+    complex_mask) where complex_mask marks the slots that still need
+    the per-message path (MsgSnap only). MsgApp entry payloads are NOT
+    attached here (the arena lives in the caller)."""
     typ = np.asarray(out.type)
     n_ents = np.asarray(out.n_ents)
     simple = out_valid & (typ != T_SNAP)
     rows, tgt, k = np.nonzero(simple)
-    rec = np.empty(len(rows), REC_DTYPE)
+    rec = np.zeros(len(rows), REC_DTYPE)
     t = typ[rows, tgt, k]
     rec["row"] = rows
     rec["to"] = tgt + 1
@@ -274,11 +440,11 @@ def merge_blocks(
     that key stay queued behind it. Returns the residual blocks (in
     order).
 
-    ``land_entries(row, base_index, ents)`` is invoked for each record
-    with entries that LANDS this round — the caller writes the entry
-    payloads into its arena at that moment (entries of a deferred
-    record stay with it in the residual).
-    """
+    ``land_entries(blk, idx)`` is invoked once per block with the
+    record indexes (into ``blk``) whose entry-carrying records LAND
+    this round — the caller bulk-copies the payload slices into its
+    arena at that moment (entries of a deferred record stay with it in
+    the residual)."""
     valid = dense["valid"]
     n_keys = valid.size
     flat_valid = valid.reshape(-1)
@@ -326,24 +492,22 @@ def merge_blocks(
                 # stays self-consistent for every caller.
                 ne = np.minimum(ne, e_cap)
             flat["n_ents"][idx] = ne
-        if flat_ents is not None or land_entries is not None:
-            for i in np.nonzero(take & (rec["n_ents"] > 0))[0]:
-                ents = blk.ents[i]
-                if ents is None:
-                    continue
-                if flat_ents is not None:
-                    terms = [t for t, _e, _d in ents[:e_cap]]
-                    flat_ents[key[i], :len(terms)] = terms
-                if land_entries is not None:
-                    land_entries(int(rec["row"][i]),
-                                 int(rec["index"][i]), ents)
+        land = np.nonzero(take & (blk.ent_counts > 0))[0]
+        if len(land):
+            if flat_ents is not None:
+                # Bulk ragged scatter of the landing records' entry
+                # terms (clamped to the inbox capacity per record).
+                cl = np.minimum(blk.ent_counts[land], e_cap)
+                rows_rep = np.repeat(key[land], cl)
+                offs = ragged_ranges(np.zeros(len(land), np.int64), cl)
+                eidx = ragged_ranges(blk._ent_starts()[land], cl)
+                flat_ents[rows_rep, offs] = blk.ent_term[eidx]
+            if land_entries is not None:
+                land_entries(blk, land)
         rest = ~take
         if rest.any():
             barred[key[rest]] = True
-            residual.append(MsgBlock(
-                rec[rest],
-                [e for e, keep in zip(blk.ents, rest) if keep],
-            ))
+            residual.append(blk.take(rest))
     return residual
 
 

@@ -50,8 +50,9 @@ from ..raft.types import (
 )
 from .msgblock import (
     MsgBlock,
-    collect_block,
+    compact_records,
     merge_blocks,
+    ragged_ranges,
     validate_block,
 )
 from .state import BatchedConfig, BatchedState, LEADER, I32, init_state
@@ -75,6 +76,7 @@ from .step import (
     T_VOTE_RESP,
     MsgSlots,
     make_step_round,
+    pack_outbox,
 )
 
 # Inbox lane for each wire type (lanes are capacity classes; handlers
@@ -115,6 +117,40 @@ class RowRestore:
     fenced: bool = False
 
 
+_EMPTY_I8 = np.empty(0, np.int64)
+
+
+class EntryBatch:
+    """SoA batch of entry records to persist: parallel numpy arrays
+    (row, index, term, etype) plus the payload list, in row-ascending
+    index-ascending order. Iterates as (row, index, term, data, etype)
+    tuples — the legacy consumer shape — while the arrays feed the
+    hosting layer's batched WAL serialization directly (one numpy
+    header array + one payload join per persistence batch, no
+    per-entry struct.pack)."""
+
+    __slots__ = ("rows", "idx", "term", "etype", "datas")
+
+    def __init__(self, rows: np.ndarray = _EMPTY_I8,
+                 idx: np.ndarray = _EMPTY_I8,
+                 term: np.ndarray = _EMPTY_I8,
+                 etype: np.ndarray = _EMPTY_I8,
+                 datas: Optional[List[bytes]] = None) -> None:
+        self.rows = rows
+        self.idx = idx
+        self.term = term
+        self.etype = etype
+        self.datas = datas if datas is not None else []
+
+    def __len__(self) -> int:
+        return len(self.datas)
+
+    def __iter__(self):
+        return iter(zip(self.rows.tolist(), self.idx.tolist(),
+                        self.term.tolist(), self.datas,
+                        self.etype.tolist()))
+
+
 @dataclass
 class BatchedReady:
     """One round's outstanding work (ref: raft/node.go:52-90 Ready,
@@ -122,7 +158,7 @@ class BatchedReady:
     apply committed → messages → advance()."""
 
     hardstates: List[Tuple[int, int, int, int]]  # (row, term, vote, commit)
-    entries: List[Tuple[int, int, int, bytes]]  # (row, index, term, data)
+    entries: "EntryBatch"  # (row, index, term, data, etype) records
     # Device-installed snapshot restores this round: (row, index, term).
     # App-state restore happened host-side when the MsgSnap was staged.
     snapshots: List[Tuple[int, int, int]]
@@ -212,8 +248,9 @@ class BatchedRawNode:
             return jnp.asarray(x)
 
         self._dev = dev
+        self._slots_j = dev(slots)
         self._step = make_step_round(
-            cfg, iids=dev(iids), slots=dev(slots), with_aux=True,
+            cfg, iids=dev(iids), slots=self._slots_j, with_aux=True,
         )
 
         self.state = init_state(cfg, start_index, iids=jnp.asarray(iids))
@@ -281,11 +318,23 @@ class BatchedRawNode:
         # In-flight round (between advance_round and advance).
         self._round: Optional[Tuple] = None
 
-        # Opt-in phase profiling (ETCD_TPU_PROF=1): per-phase seconds,
-        # read by benches/BENCH_NOTES captures.
+        # Per-round phase wall-seconds, always measured (four
+        # perf_counter reads per round — noise next to a device round):
+        # stage (inbox build), step (device round + host reads),
+        # extract (post-round entry/commit extraction), collect
+        # (outbound block assembly). The hosting layer folds these into
+        # its phase histograms so the BENCH_NOTES phase breakdown is
+        # reproducible from metrics.
+        self.phase_last: Dict[str, float] = {
+            "stage": 0.0, "step": 0.0, "extract": 0.0, "collect": 0.0}
+        # Opt-in cumulative profile (ETCD_TPU_PROF=1): same keys plus a
+        # round counter and the staging-lock acquire wait (stage_lock,
+        # a subset of stage: time spent waiting for _lock against
+        # proposer/transport threads — convoy, not work), read by
+        # benches/BENCH_NOTES captures.
         self.prof: Optional[Dict[str, float]] = (
-            {"inbox": 0.0, "step": 0.0, "post": 0.0, "collect": 0.0,
-             "rounds": 0}
+            {"stage": 0.0, "stage_lock": 0.0, "step": 0.0,
+             "extract": 0.0, "collect": 0.0, "rounds": 0}
             if os.environ.get("ETCD_TPU_PROF") else None
         )
 
@@ -528,9 +577,12 @@ class BatchedRawNode:
         cfg = self.cfg
         r, e, w = cfg.num_replicas, cfg.max_ents_per_msg, cfg.window
         prof = self.prof
-        t0 = time.perf_counter() if prof is not None else 0.0
+        t0 = time.perf_counter()
 
-        with self._lock:
+        self._lock.acquire()
+        if prof is not None:
+            prof["stage_lock"] += time.perf_counter() - t0
+        try:
             inbox = self._build_inbox()
             ticks = self._ticks > 0
             self._ticks = np.maximum(self._ticks - 1, 0)
@@ -556,10 +608,13 @@ class BatchedRawNode:
                 (min(len(q), cfg.max_props_per_round) for q in self._props),
                 np.int32, count=self.n,
             )
+        finally:
+            self._lock.release()
+        t1 = time.perf_counter()
+        self.phase_last["stage"] = t1 - t0
         if prof is not None:
-            t1 = time.perf_counter()
-            prof["inbox"] += t1 - t0
-            t0 = t1
+            prof["stage"] += t1 - t0
+        t0 = t1
 
         # Host-staged device-state edits (membership masks, ring-floor
         # compaction, bcastAppend pokes), applied here on the round
@@ -617,6 +672,12 @@ class BatchedRawNode:
         st, outbox, aux = step_out[:3]
         frame = step_out[3] if cfg.telemetry else None
         self.state = st
+        # On-device outbox packing: a tiny second program turns the
+        # [n, R, K] outbox fields into wire-width record words (rows of
+        # msgblock.REC_DTYPE bytes) plus block/object masks, so the
+        # host-side collect below is one view-cast + boolean take
+        # instead of 14 fancy-indexed gathers.
+        words_d, simple_d, cplx_d = pack_outbox(outbox, self._slots_j)
 
         # Device→host reads go through np.asarray, NOT jax.device_get:
         # this build's device_get pays a fixed ~4ms per buffer (measured
@@ -635,7 +696,9 @@ class BatchedRawNode:
                 aux.last_tick,
             )
         ]
-        out_np = jax.tree.map(np.asarray, outbox)
+        words = np.asarray(words_d)
+        simple = np.asarray(simple_d)
+        cplx = np.asarray(cplx_d)
         if frame is not None:
             # Same host gather as the state reads above — the counters
             # were accumulated in-kernel; no extra sync happens here.
@@ -647,11 +710,13 @@ class BatchedRawNode:
 
                 self.telemetry_hub.ingest_round(
                     tel_counters, tel_inv,
-                    extra={"outbox_lanes": lane_summary(out_np.valid)})
+                    extra={"outbox_lanes": lane_summary(
+                        np.asarray(outbox.valid))})
+        t1 = time.perf_counter()
+        self.phase_last["step"] = t1 - t0
         if prof is not None:
-            t1 = time.perf_counter()
             prof["step"] += t1 - t0
-            t0 = t1
+        t0 = t1
 
         term = term.astype(np.int64)
         vote = vote.astype(np.int64)
@@ -670,52 +735,76 @@ class BatchedRawNode:
             # -- proposals: pop exactly as many as the device appended
             # and assign their indexes (the propose phase spans
             # (last_tick, last]).
-            for row in np.nonzero(last > last_tick)[0]:
+            for row in np.nonzero(last > last_tick)[0].tolist():
                 q = self._props[row]
                 n_app = int(last[row] - last_tick[row])
                 base = int(last_tick[row])
+                t_row = int(term[row])
+                ar = self.arena[row]
+                ets = self.etypes[row]
                 for j in range(n_app):
                     data, et = q.popleft()
                     idx = base + 1 + j
-                    self.arena[row][idx] = (int(term[row]), data)
-                    self.etypes[row].pop(idx, None)
+                    ar[idx] = (t_row, data)
+                    ets.pop(idx, None)
                     if et:
-                        self.etypes[row][idx] = et
+                        ets[idx] = et
 
-            # -- entry records to persist: contiguous (fc-1, last] where
-            # fc is the first ring-changed index this round (or stable+1).
-            entries: List[Tuple[int, int, int, bytes]] = []
-            snapshots: List[Tuple[int, int, int]] = []
+            # -- entry records to persist: per row the contiguous range
+            # (lo-1, last] where lo is the first ring-changed index
+            # this round (or stable+1) — range math fully vectorized,
+            # Python only touches the actual entries (payload lookups).
+            snap64 = snap_i.astype(np.int64)
+            snap_rows = np.nonzero(snap64 > self.m_last)[0]
+            # Device installed snapshots past our old log: ring floor
+            # jumped. Record them; entries beyond follow.
+            snapshots: List[Tuple[int, int, int]] = [
+                (row, int(snap_i[row]), int(snap_t[row]))
+                for row in snap_rows.tolist()
+            ]
             restored = np.zeros(self.n, bool)
-            for row in range(self.n):
-                if snap_i[row] > self.m_last[row]:
-                    # Device installed a snapshot past our old log: ring
-                    # floor jumped. Record it; entries beyond follow.
-                    snapshots.append(
-                        (row, int(snap_i[row]), int(snap_t[row]))
-                    )
-                    restored[row] = True
+            restored[snap_rows] = True
             changed = ring64 != self.m_ring
             rows_changed = np.nonzero(
                 changed.any(axis=1) | (last > self.stable) | restored
             )[0]
-            for row in rows_changed:
-                lo = int(self.stable[row]) + 1
-                pos = np.nonzero(changed[row])[0]
-                if len(pos):
-                    li = int(last[row])
-                    idxs = li - ((li - pos) % w)
-                    idxs = idxs[idxs > snap_i[row]]
-                    if len(idxs):
-                        lo = min(lo, int(idxs.min()))
-                lo = max(lo, int(snap_i[row]) + 1)
-                for i in range(lo, int(last[row]) + 1):
-                    t = int(ring64[row, i % w])
-                    ar = self.arena[row].get(i)
-                    ok = ar is not None and ar[0] == t
-                    data = ar[1] if ok else b""
-                    et = self.etypes[row].get(i, 0) if ok else 0
-                    entries.append((row, i, t, data, et))
+            entries = EntryBatch()
+            if len(rows_changed):
+                lastc = last[rows_changed]
+                snapc = snap64[rows_changed]
+                wgrid = np.arange(w, dtype=np.int64)
+                # Log index currently held by ring slot p of each row.
+                idxs = lastc[:, None] - ((lastc[:, None] - wgrid) % w)
+                big = np.int64(1) << 62
+                cand = np.where(
+                    changed[rows_changed] & (idxs > snapc[:, None]),
+                    idxs, big)
+                lo = np.minimum(
+                    self.stable[rows_changed] + 1, cand.min(axis=1))
+                lo = np.maximum(lo, snapc + 1)
+                cnt = np.maximum(lastc - lo + 1, 0)
+                sel = cnt > 0
+                if sel.any():
+                    rows2 = rows_changed[sel]
+                    cnt2 = cnt[sel]
+                    eb_rows = np.repeat(rows2, cnt2)
+                    eb_idx = ragged_ranges(lo[sel], cnt2)
+                    eb_term = ring64[eb_rows, eb_idx % w]
+                    datas: List[bytes] = []
+                    etys: List[int] = []
+                    for row, i, t in zip(eb_rows.tolist(),
+                                         eb_idx.tolist(),
+                                         eb_term.tolist()):
+                        a = self.arena[row].get(i)
+                        if a is not None and a[0] == t:
+                            datas.append(a[1])
+                            etys.append(self.etypes[row].get(i, 0))
+                        else:
+                            datas.append(b"")
+                            etys.append(0)
+                    entries = EntryBatch(
+                        eb_rows, eb_idx, eb_term,
+                        np.asarray(etys, np.int64), datas)
 
             # -- hardstate deltas
             hardstates = [
@@ -730,30 +819,49 @@ class BatchedRawNode:
             committed: List[
                 Tuple[int, List[Tuple[int, int, Optional[bytes]]]]
             ] = []
-            for row in np.nonzero(commit > self.applied)[0]:
-                lo = max(int(self.applied[row]), int(snap_i[row]))
-                items: List[Tuple[int, int, Optional[bytes]]] = []
-                for i in range(lo + 1, int(commit[row]) + 1):
-                    t = int(ring64[row, i % w])
-                    ar = self.arena[row].get(i)
-                    ok = ar is not None and ar[0] == t
-                    data = ar[1] if ok and ar[1] else None
-                    et = self.etypes[row].get(i, 0) if ok else 0
-                    items.append((i, t, data, et))
-                if items:
-                    committed.append((int(row), items))
+            com_rows = np.nonzero(commit > self.applied)[0]
+            if len(com_rows):
+                loc = np.maximum(self.applied[com_rows], snap64[com_rows])
+                cntc = np.maximum(commit[com_rows] - loc, 0)
+                selc = cntc > 0
+                rows3 = com_rows[selc]
+                cnt3 = cntc[selc]
+                c_rows = np.repeat(rows3, cnt3)
+                c_idx = ragged_ranges(loc[selc] + 1, cnt3)
+                c_term = ring64[c_rows, c_idx % w]
+                idx_l = c_idx.tolist()
+                term_l = c_term.tolist()
+                pos = 0
+                for row, end in zip(rows3.tolist(),
+                                    np.cumsum(cnt3).tolist()):
+                    ar = self.arena[row]
+                    ets = self.etypes[row]
+                    items: List[Tuple[int, int, Optional[bytes], int]] = []
+                    for k in range(pos, end):
+                        i, t = idx_l[k], term_l[k]
+                        a = ar.get(i)
+                        ok = a is not None and a[0] == t
+                        items.append((
+                            i, t,
+                            a[1] if ok and a[1] else None,
+                            ets.get(i, 0) if ok else 0,
+                        ))
+                    pos = end
+                    committed.append((row, items))
 
+            t1 = time.perf_counter()
+            self.phase_last["extract"] = t1 - t0
             if prof is not None:
-                t1 = time.perf_counter()
-                prof["post"] += t1 - t0
-                t0 = t1
+                prof["extract"] += t1 - t0
+            t0 = t1
 
             # -- outbound messages (MsgApp payloads come from the arena)
             msg_block, messages = self._collect_messages(
-                out_np, ring64, snap_i, last, term, commit
+                words, simple, cplx, outbox
             )
+            t1 = time.perf_counter()
+            self.phase_last["collect"] = t1 - t0
             if prof is not None:
-                t1 = time.perf_counter()
                 prof["collect"] += t1 - t0
                 prof["rounds"] += 1
 
@@ -884,22 +992,41 @@ class BatchedRawNode:
         for key in dead:
             del self._pending[key]
         if self._blocks:
-            def land_entries(row: int, base: int, ents) -> None:
+            def land_entries(blk: MsgBlock, land: np.ndarray) -> None:
                 # A block MsgApp's payloads enter the arena the moment
                 # the record lands in the inbox — the block twin of
                 # step()'s arena writes, same never-clobber-committed
                 # rule (committed entries are immutable; only fill
-                # gaps there, post-snapshot resends).
-                ar = self.arena[row]
-                et = self.etypes[row]
-                guard = self._commit_guard[row]
-                for j, (tm, ety, data) in enumerate(ents):
-                    i2 = base + 1 + j
-                    if i2 > guard or i2 not in ar:
-                        ar[i2] = (tm, data)
-                        et.pop(i2, None)
-                        if ety:
-                            et[i2] = ety
+                # gaps there, post-snapshot resends). One bulk call per
+                # block: the arena slices come straight off the flat
+                # entry arena (offset math, no per-entry parsing).
+                rec = blk.rec
+                rows_l = rec["row"][land].tolist()
+                base_l = rec["index"][land].tolist()
+                cnt = blk.ent_counts[land]
+                # Gather ONLY the landed records' arena rows before the
+                # Python conversion — a residual-heavy block re-merges
+                # every round and must not pay for its deferred tail.
+                eidx = ragged_ranges(blk._ent_starts()[land], cnt)
+                term_l = blk.ent_term[eidx].tolist()
+                ety_l = blk.ent_etype[eidx].tolist()
+                len_l = blk.ent_len[eidx].tolist()
+                ps_l = blk._pay_starts()[eidx].tolist()
+                pay = blk.payload
+                k = 0
+                for row, base, c in zip(rows_l, base_l, cnt.tolist()):
+                    ar = self.arena[row]
+                    et = self.etypes[row]
+                    guard = self._commit_guard[row]
+                    for j in range(c):
+                        i2 = base + 1 + j
+                        if i2 > guard or i2 not in ar:
+                            a = ps_l[k]
+                            ar[i2] = (term_l[k], pay[a:a + len_l[k]])
+                            et.pop(i2, None)
+                            if ety_l[k]:
+                                et[i2] = ety_l[k]
+                        k += 1
 
             residual = merge_blocks(
                 list(self._blocks), r, NUM_KINDS,
@@ -923,64 +1050,94 @@ class BatchedRawNode:
         )
         return inbox
 
-    def _collect_messages(self, out, ring64, snap_i, last, term, commit):
-        """outbox slots → one SoA block for everything except MsgSnap
-        (whose app-state payload the hosting layer attaches at send
-        time). MsgApp entry payloads ride the block's entries section,
-        re-attached from the arena in record order."""
-        w = self.cfg.window
-        block, complex_mask = collect_block(
-            np.asarray(out.valid), out, self.slots
-        )
-        # Fill the block's entry payloads from the arena.
-        rec = block.rec
-        for i in np.nonzero(rec["n_ents"])[0]:
-            row = int(rec["row"][i])
-            base = int(rec["index"][i])
-            ar = self.arena[row]
-            ets = self.etypes[row]
-            tgt = int(rec["to"][i]) - 1
-            k = int(rec["lane"][i])
-            ents = []
-            for j in range(int(rec["n_ents"][i])):
-                idx = base + 1 + j
-                et = int(out.ent_terms[row, tgt, k, j])
-                a = ar.get(idx)
-                ok = a is not None and a[0] == et
-                ents.append((et, ets.get(idx, 0) if ok else 0,
-                             a[1] if ok else b""))
-            block.ents[int(i)] = ents
+    def _collect_messages(self, words, simple, cplx, outbox):
+        """Device-packed outbox → one SoA block for everything except
+        MsgSnap (whose app-state payload the hosting layer attaches at
+        send time). The record array is a view-cast of the packed word
+        tensor (step.pack_outbox) compressed by the block mask; MsgApp
+        entry payloads ride the block's flat arena, re-attached from
+        the host arena with one ragged gather for the terms and one
+        payload join."""
+        e = self.cfg.max_ents_per_msg
+        rec = compact_records(words, simple)
+        block = MsgBlock(rec)
+        napp = rec["n_ents"]
+        app_sel = np.nonzero(napp)[0]
+        if len(app_sel):
+            counts = napp[app_sel].astype(np.int64)
+            # Flat outbox slot of each entry-carrying record (for the
+            # [M, E] ent_terms gather) and its per-entry offsets.
+            flat_pos = np.nonzero(simple)[0][app_sel]
+            offs = ragged_ranges(np.zeros(len(app_sel), np.int64),
+                                 counts)
+            etf = np.asarray(outbox.ent_terms).reshape(-1, e)
+            terms = etf[np.repeat(flat_pos, counts), offs]
+            idx_flat = (np.repeat(rec["index"][app_sel].astype(np.int64),
+                                  counts) + 1 + offs)
+            rows_rep = np.repeat(rec["row"][app_sel].astype(np.int64),
+                                 counts)
+            datas: List[bytes] = []
+            etys = np.zeros(len(idx_flat), "<u1")
+            k = 0
+            for row, idx, et in zip(rows_rep.tolist(),
+                                    idx_flat.tolist(), terms.tolist()):
+                a = self.arena[row].get(idx)
+                if a is not None and a[0] == et:
+                    datas.append(a[1])
+                    ety = self.etypes[row].get(idx, 0)
+                    if ety:
+                        etys[k] = ety
+                else:
+                    datas.append(b"")
+                k += 1
+            block = MsgBlock(
+                rec, ent_term=terms.astype("<u4"), ent_etype=etys,
+                ent_len=np.fromiter(map(len, datas), np.uint32,
+                                    len(datas)),
+                payload=b"".join(datas))
         msgs: List[Tuple[int, Message]] = []
-        rows, targets, kinds = np.nonzero(complex_mask)
-        for row, tgt, k in zip(rows, targets, kinds):
-            t = int(out.type[row, tgt, k])
-            m = Message(
-                type=MessageType(t),
-                to=int(tgt) + 1,
-                from_=int(self.slots[row]) + 1,
-                term=int(out.term[row, tgt, k]),
-                log_term=int(out.log_term[row, tgt, k]),
-                index=int(out.index[row, tgt, k]),
-                commit=int(out.commit[row, tgt, k]),
-                reject=bool(out.reject[row, tgt, k]),
-                reject_hint=int(out.reject_hint[row, tgt, k]),
-            )
-            cw = int(out.ctx[row, tgt, k])
-            if cw:
-                # The device ctx word travels as 4 context bytes
-                # (the reference's Message.Context).
-                m.context = cw.to_bytes(4, "little")
-            if t == T_SNAP:
-                # metadata only; the hosting layer attaches app data
-                # (at its applied watermark ≥ this floor) before the
-                # wire (see hosting.py / node.py).
-                m.snapshot = Snapshot(
-                    metadata=SnapshotMetadata(
-                        index=int(out.index[row, tgt, k]),
-                        term=int(out.log_term[row, tgt, k]),
-                    )
+        if cplx.any():
+            # MsgSnap only (rare): materialize just the needed fields
+            # for just these flat slots.
+            p = np.nonzero(cplx)[0]
+            fld = lambda name: (  # noqa: E731
+                np.asarray(getattr(outbox, name)).reshape(-1)[p].tolist())
+            k6 = NUM_KINDS
+            r = self.cfg.num_replicas
+            rows_c = (p // (r * k6)).tolist()
+            tgts_c = ((p % (r * k6)) // k6).tolist()
+            typs, terms_c, lts, idxs, cms, rejs, hints, ctxs = (
+                fld("type"), fld("term"), fld("log_term"), fld("index"),
+                fld("commit"), fld("reject"), fld("reject_hint"),
+                fld("ctx"))
+            for j, row in enumerate(rows_c):
+                t = int(typs[j])
+                m = Message(
+                    type=MessageType(t),
+                    to=tgts_c[j] + 1,
+                    from_=int(self.slots[row]) + 1,
+                    term=terms_c[j],
+                    log_term=lts[j],
+                    index=idxs[j],
+                    commit=cms[j],
+                    reject=bool(rejs[j]),
+                    reject_hint=hints[j],
                 )
-            msgs.append((int(row), m))
+                cw = ctxs[j]
+                if cw:
+                    # The device ctx word travels as 4 context bytes
+                    # (the reference's Message.Context).
+                    m.context = int(cw).to_bytes(4, "little")
+                if t == T_SNAP:
+                    # metadata only; the hosting layer attaches app
+                    # data (at its applied watermark ≥ this floor)
+                    # before the wire (see hosting.py / node.py).
+                    m.snapshot = Snapshot(
+                        metadata=SnapshotMetadata(
+                            index=idxs[j], term=lts[j],
+                        )
+                    )
+                msgs.append((row, m))
         return block, msgs
 
     # -- introspection ---------------------------------------------------------

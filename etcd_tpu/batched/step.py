@@ -33,6 +33,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .kernels import (
     VOTE_LOST,
@@ -76,6 +77,21 @@ T_SNAP = 7
 T_HB, T_HB_RESP = 8, 9
 T_TIMEOUT_NOW = 14
 T_PREVOTE, T_PREVOTE_RESP = 17, 18
+
+# Wire type -> inbox lane, as a lookup table usable both host-side
+# (msgblock codec validation) and on device (pack_outbox); -1 marks
+# unroutable types (mirrors rawnode._LANE).
+NUM_WIRE_TYPES = 32
+LANE_OF = np.full(NUM_WIRE_TYPES, -1, np.int8)
+for _t, _lane in (
+    (T_VOTE, KIND_VOTE), (T_PREVOTE, KIND_VOTE),
+    (T_APP, KIND_APP), (T_SNAP, KIND_APP),
+    (T_HB, KIND_HB), (T_TIMEOUT_NOW, KIND_HB),
+    (T_VOTE_RESP, KIND_VOTE_RESP), (T_PREVOTE_RESP, KIND_VOTE_RESP),
+    (T_APP_RESP, KIND_APP_RESP),
+    (T_HB_RESP, KIND_HB_RESP),
+):
+    LANE_OF[_t] = _lane
 
 
 class MsgSlots(NamedTuple):
@@ -1340,3 +1356,62 @@ def make_step_round(cfg: BatchedConfig, iids=None, slots=None,
                      iids, slots)
 
     return step
+
+
+# -----------------------------------------------------------------------------
+# On-device outbox packing (the hosted collect fast path)
+# -----------------------------------------------------------------------------
+
+# Words per wire record: the device emits outbox messages pre-packed at
+# wire widths — [M, REC_WORDS] i32 rows whose little-endian bytes ARE
+# msgblock.REC_DTYPE records. The host then materializes the round's
+# outbound block with one np.asarray + view-cast + boolean take instead
+# of 14 fancy-indexed gathers over [n, R, K] fields (msgblock
+# compact_records).
+REC_WORDS = 9
+
+
+@functools.lru_cache(maxsize=None)
+def _pack_outbox_jit():
+    # Unroutable types pack lane 0; they are never valid so the host
+    # compress drops them (a -1 lane would smear into the type byte).
+    lane_tab = jnp.asarray(np.maximum(LANE_OF, 0).astype(np.int32))
+
+    def pack(valid, typ, reject, n_ents, term, log_term, index, commit,
+             reject_hint, ctx, slots):
+        n, r, _k = typ.shape
+        shape = typ.shape
+        rows = jnp.broadcast_to(
+            jnp.arange(n, dtype=I32)[:, None, None], shape)
+        to = jnp.broadcast_to(
+            jnp.arange(1, r + 1, dtype=I32)[None, :, None], shape)
+        frm = jnp.broadcast_to(
+            (slots.astype(I32) + 1)[:, None, None], shape)
+        lane = lane_tab[jnp.clip(typ, 0, NUM_WIRE_TYPES - 1)]
+        # Little-endian byte lanes of REC_DTYPE's packed u1 fields.
+        w_addr = to | (frm << 8) | (lane << 16) | (typ << 24)
+        ne = jnp.where(typ == T_APP, n_ents, 0)
+        w_flags = reject.astype(I32) | (ne << 8)
+        words = jnp.stack(
+            (rows, w_addr, w_flags, term, log_term, index, commit,
+             reject_hint, ctx), axis=-1)
+        simple = (valid & (typ != T_SNAP)).reshape(-1)
+        cplx = (valid & (typ == T_SNAP)).reshape(-1)
+        return words.reshape(-1, REC_WORDS), simple, cplx
+
+    return jax.jit(pack)
+
+
+def pack_outbox(out: MsgSlots, slots):
+    """Pack a device outbox into wire-record words on device.
+
+    Returns (words [M, REC_WORDS] i32, simple [M] bool, complex [M]
+    bool) with M = n*R*K flat slots: `simple` marks block-eligible
+    messages (everything but MsgSnap), `complex` the MsgSnap slots that
+    keep the per-message object path. The words' bytes are exactly
+    msgblock.REC_DTYPE, so the host-side collect is a view-cast."""
+    return _pack_outbox_jit()(
+        out.valid, out.type, out.reject, out.n_ents, out.term,
+        out.log_term, out.index, out.commit, out.reject_hint, out.ctx,
+        slots,
+    )

@@ -5,8 +5,15 @@ kernel rate (VERDICT r04 task #1: a per-round artifact with a floor).
 Writes HOSTED_BENCH.json at the repo root:
 
     {"puts_per_sec": ..., "p50_ms": ..., "p99_ms": ...,
-     "n": ..., "groups_led": ..., "restart_catchup_s": ...,
-     "config": "...", "captured_at": "..."}
+     "n": ..., "groups_led": ...,
+     "phase_ms_per_round": {"stage": ..., "step": ..., "extract": ...,
+                            "collect": ..., "wal": ..., "apply": ...,
+                            "send": ...},
+     "restart_catchup_s": ..., "config": "...", "captured_at": "..."}
+
+(phase_ms_per_round is the member-round budget averaged over members —
+the BENCH_NOTES phase table, reproducible from the artifact; the same
+split is exported as the round-phase histograms under --telemetry.)
 
 Run:  python -m etcd_tpu.tools.hosted_bench [--groups 1024] [--n 3000]
 """
@@ -155,10 +162,29 @@ def main() -> None:
         bad = [p for p in parts if not p.get("ok")]
         if bad:
             raise RuntimeError(f"bench failed: {bad}")
+        # Per-phase member-round budget (ms/round, averaged over the
+        # members): stage/step/extract/collect from the rawnode timers
+        # (ETCD_TPU_PROF is set on the workers), wal/apply/send from
+        # the member pipeline stats — the BENCH_NOTES phase table,
+        # recorded in the artifact instead of ad-hoc profiling.
+        phase_ms = {}
         for mid, c in clients.items():
             prof = c.call(op="prof")
-            print(f"member {mid} prof: {prof.get('stats')}",
-                  file=sys.stderr)
+            st = prof.get("stats", {})
+            print(f"member {mid} prof: {st}", file=sys.stderr)
+            rounds = max(st.get("rn_rounds", 0), 1)
+            m_rounds = max(st.get("rounds", 0), 1)
+            for p in ("stage", "step", "extract", "collect"):
+                v = st.get(f"rn_{p}")
+                if v is not None:
+                    phase_ms.setdefault(p, []).append(v / rounds * 1e3)
+            for p in ("wal", "apply", "send"):
+                v = st.get(f"{p}_s")
+                if v is not None:
+                    phase_ms.setdefault(p, []).append(v / m_rounds * 1e3)
+        phase_ms = {
+            p: round(sum(v) / len(v), 2) for p, v in phase_ms.items()
+        }
         # Aggregate: throughputs add (concurrent windows); percentiles
         # come from the UNION of the members' latency samples.
         total_done = sum(p["completed"] for p in parts)
@@ -206,6 +232,7 @@ def main() -> None:
             "completed": bench.get("completed", bench["n"]),
             "lost": bench.get("lost", 0),
             "groups_led": bench["groups"],
+            "phase_ms_per_round": phase_ms,
             "restart_catchup_s": round(catchup_s, 1),
             "config": (f"G={args.groups} R={MEMBERS} procs={MEMBERS} "
                        f"value={args.value_size}B "

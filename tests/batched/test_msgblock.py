@@ -20,6 +20,7 @@ from etcd_tpu.batched.msgblock import (
     LANE_OF,
     REC_DTYPE,
     MsgBlock,
+    block_messages,
     collect_block,
     merge_blocks,
     validate_block,
@@ -100,7 +101,8 @@ class TestWireRoundTrip:
         blk = MsgBlock(rec)
         out = MsgBlock.from_bytes(blk.to_bytes())
         assert (out.rec == rec).all()
-        assert len(blk.to_bytes()) == 4 + n * REC_DTYPE.itemsize
+        # v2 frame: version byte + u4 count + records + u4 entry count.
+        assert len(blk.to_bytes()) == 5 + n * REC_DTYPE.itemsize + 4
 
     def test_from_bytes_rejects_partial_record(self):
         good = MsgBlock(rec_of(0, 1, T_HB)).to_bytes()
@@ -108,6 +110,18 @@ class TestWireRoundTrip:
             MsgBlock.from_bytes(good[:-1])
         with pytest.raises(ValueError):
             MsgBlock.from_bytes(good + b"x")
+
+    def test_from_bytes_rejects_wrong_version(self):
+        """Wire-format version fencing: a frame from a different codec
+        generation must be rejected at decode (the transport counts
+        recv_corrupt and drops the connection), never misparsed."""
+        good = MsgBlock(rec_of(0, 1, T_HB)).to_bytes()
+        from etcd_tpu.batched.msgblock import WIRE_VERSION
+
+        assert good[0] == WIRE_VERSION
+        for ver in (0, 1, WIRE_VERSION + 1, 255):
+            with pytest.raises(ValueError, match="version"):
+                MsgBlock.from_bytes(bytes([ver]) + good[1:])
 
     def test_roundtrip_with_entries(self):
         rec = recs(
@@ -182,6 +196,26 @@ class TestValidate:
                       [[(1, 0, b"x"), (1, 0, b"y")]])
         assert len(validate_block(ok, 10, R, max_ents=8)) == 1
 
+    def test_arena_not_backing_claimed_counts_dropped(self):
+        """A hand-built arena block whose ent_counts default from
+        rec["n_ents"] but whose arrays hold fewer entries must not pass
+        validation (it would IndexError the merge/take gathers); its
+        payload-free records survive."""
+        rec = recs(rec_of(0, 1, T_APP, n_ents=2), rec_of(1, 1, T_HB))
+        lying = MsgBlock(
+            rec, ent_term=np.asarray([7], "<u4"),
+            ent_etype=np.asarray([0], "<u1"),
+            ent_len=np.asarray([1], "<u4"), payload=b"x")
+        out = validate_block(lying, 10, R, max_ents=8)
+        assert len(out) == 1 and out.rec["type"][0] == T_HB
+        # Same lie in the payload buffer (lengths vs bytes).
+        lying2 = MsgBlock(
+            rec, ent_term=np.asarray([7, 8], "<u4"),
+            ent_etype=np.asarray([0, 0], "<u1"),
+            ent_len=np.asarray([3, 3], "<u4"), payload=b"x")
+        out2 = validate_block(lying2, 10, R, max_ents=8)
+        assert len(out2) == 1 and out2.rec["type"][0] == T_HB
+
     def test_forged_snap_dropped(self):
         # A T_SNAP record with its own (legal) lane would fast-forward
         # device raft state with no host app-state restore — snapshots
@@ -202,7 +236,10 @@ class TestValidate:
         garbage["type"] = [T_HB, T_HB, 255 % 32]
         import struct as _st
 
-        frame = _st.pack("<I", len(garbage)) + garbage.tobytes()
+        from etcd_tpu.batched.msgblock import WIRE_VERSION
+
+        frame = (_st.pack("<BI", WIRE_VERSION, len(garbage))
+                 + garbage.tobytes() + _st.pack("<I", 0))
         rn.step_block(MsgBlock.from_bytes(frame))
         rn.advance_round()  # must not raise
         rn.advance()
@@ -436,10 +473,201 @@ class TestWireCountBounds:
         dense = make_dense(n)
         dense["n_ents"] = np.zeros((n, R, NUM_KINDS), np.int32)
         landed = []
+
+        def land(b, idx):
+            for i in idx.tolist():
+                landed.append((int(b.rec["row"][i]),
+                               int(b.rec["index"][i]),
+                               len(b.entry_list(i))))
+
         ents = [(9, 0, b"x")] * 5
         blk = MsgBlock(rec_of(2, 1, T_APP, index=4, n_ents=5), [ents])
-        merge_blocks([blk], R, NUM_KINDS, dense,
-                     land_entries=lambda row, base, e: landed.append(
-                         (row, base, len(e))))
+        merge_blocks([blk], R, NUM_KINDS, dense, land_entries=land)
         assert dense["n_ents"][2, 0, LANE_OF[T_APP]] == 5
         assert landed == [(2, 4, 5)]
+
+
+def _random_block(rng, n_rows=64, max_ents=4):
+    """A random mixed block (payload-free + entry-carrying records)
+    built through the compat ents-list constructor."""
+    n = int(rng.randint(1, 40))
+    rec = np.zeros(n, REC_DTYPE)
+    ents = []
+    for i in range(n):
+        has_ents = rng.rand() < 0.4
+        typ = T_APP if has_ents else int(rng.choice(
+            [T_HB, T_HB_RESP, T_VOTE, T_VOTE_RESP, T_APP_RESP, T_APP]))
+        ne = int(rng.randint(1, max_ents + 1)) if has_ents else 0
+        rec[i]["row"] = rng.randint(0, n_rows)
+        rec[i]["to"] = rng.randint(1, R + 1)
+        rec[i]["frm"] = rng.randint(1, R + 1)
+        rec[i]["type"] = typ
+        rec[i]["lane"] = LANE_OF[typ]
+        rec[i]["n_ents"] = ne
+        rec[i]["reject"] = rng.randint(0, 2)
+        for f in ("term", "log_term", "index", "commit",
+                  "reject_hint", "ctx"):
+            rec[i][f] = rng.randint(0, 1 << 20)
+        ents.append([
+            (int(rng.randint(1, 1 << 20)), int(rng.randint(0, 3)),
+             rng.bytes(int(rng.randint(0, 80))))
+            for _ in range(ne)
+        ] if ne else None)
+    return MsgBlock(rec, ents)
+
+
+class TestArenaCodecProperty:
+    """ISSUE 6 satellite: random-block property coverage of the flat
+    entry arena format — round-trip identity, split/take consistency,
+    and fuzzed decode (never crash, never over-read)."""
+
+    def test_random_roundtrip(self):
+        rng = np.random.RandomState(11)
+        for _ in range(50):
+            blk = _random_block(rng)
+            out = MsgBlock.from_bytes(blk.to_bytes())
+            assert (out.rec == blk.rec).all()
+            assert (out.ent_term == blk.ent_term).all()
+            assert (out.ent_etype == blk.ent_etype).all()
+            assert (out.ent_len == blk.ent_len).all()
+            assert out.payload == blk.payload
+            # Per-record entry attribution survives the flat wire form.
+            assert out.ents == blk.ents
+
+    def test_split_take_preserve_entry_attribution(self):
+        rng = np.random.RandomState(13)
+        for _ in range(20):
+            blk = _random_block(rng)
+            ents = blk.ents
+            # split_by_target: every sub-block's records keep exactly
+            # their own entries, and the union covers the block.
+            total = 0
+            for to, sub in blk.split_by_target().items():
+                sel = np.nonzero(blk.rec["to"] == to)[0]
+                assert (sub.rec == blk.rec[sel]).all()
+                assert sub.ents == [ents[i] for i in sel.tolist()]
+                total += len(sub)
+            assert total == len(blk)
+            # take on a mask == list comprehension on the ents form.
+            mask = rng.rand(len(blk)) < 0.5
+            sub = blk.take(mask)
+            assert sub.ents == [e for e, m in zip(ents, mask) if m]
+            # contiguous-slice take (the TCP chunking path).
+            half = len(blk) // 2
+            lo = blk.take(slice(0, half))
+            hi = blk.take(slice(half, None))
+            assert lo.ents + hi.ents == ents
+            assert (np.concatenate([lo.rec, hi.rec]) == blk.rec).all()
+
+    def test_fuzzed_decode_never_crashes(self):
+        """Truncations, trailing garbage and random byte flips must
+        either decode (garbage records are the validator's job) or
+        raise ValueError — never IndexError/SystemError/segfault, and
+        never read beyond the frame."""
+        rng = np.random.RandomState(17)
+        for _ in range(20):
+            blk = _random_block(rng)
+            b = blk.to_bytes()
+            cuts = set(rng.randint(0, len(b), 25).tolist())
+            cuts.update((0, 1, 4, 5, len(b) - 1))
+            for cut in sorted(c for c in cuts if c < len(b)):
+                with pytest.raises(ValueError):
+                    MsgBlock.from_bytes(b[:cut])
+            with pytest.raises(ValueError):
+                MsgBlock.from_bytes(b + b"\x00")
+            for _f in range(30):
+                ba = bytearray(b)
+                pos = int(rng.randint(0, len(ba)))
+                ba[pos] ^= 1 << int(rng.randint(0, 8))
+                try:
+                    out = MsgBlock.from_bytes(bytes(ba))
+                except ValueError:
+                    continue
+                # Parsed: totals must still be self-consistent.
+                assert len(out.ent_term) == int(
+                    out.rec["n_ents"].astype(np.int64).sum())
+                assert len(out.payload) == int(
+                    out.ent_len.astype(np.int64).sum())
+
+
+class TestOldNewCodecEquivalence:
+    """ISSUE 6 satellite: the arena block and a legacy-shaped block
+    (per-record entry lists) must materialize the SAME messages —
+    block_messages is the compat contract both codec generations meet."""
+
+    def test_block_messages_differential(self):
+        rng = np.random.RandomState(23)
+        for _ in range(10):
+            blk = _random_block(rng)
+            # Old-codec shape: rebuild from per-record entry lists.
+            legacy = MsgBlock(blk.rec.copy(), blk.ents)
+            new = MsgBlock.from_bytes(blk.to_bytes())
+            got_a = block_messages(legacy)
+            got_b = block_messages(new)
+            assert len(got_a) == len(got_b) == len(blk)
+            for (ra, ma), (rb, mb) in zip(got_a, got_b):
+                assert ra == rb
+                assert ma.type == mb.type and ma.to == mb.to
+                assert ma.from_ == mb.from_ and ma.term == mb.term
+                assert ma.index == mb.index and ma.commit == mb.commit
+                assert ma.reject == mb.reject
+                assert ma.reject_hint == mb.reject_hint
+                assert ma.context == mb.context
+                assert len(ma.entries) == len(mb.entries)
+                for ea, eb in zip(ma.entries, mb.entries):
+                    assert (ea.index, ea.term, ea.type, ea.data) == \
+                        (eb.index, eb.term, eb.type, eb.data)
+
+
+class TestPackOutbox:
+    """The device-side packer (step.pack_outbox) must agree with the
+    reference per-field collect (collect_block) record for record."""
+
+    def test_pack_matches_collect(self):
+        import jax.numpy as jnp
+
+        from etcd_tpu.batched.msgblock import compact_records
+        from etcd_tpu.batched.step import (
+            KIND_APP,
+            T_SNAP,
+            empty_msgs,
+            pack_outbox,
+        )
+
+        rng = np.random.RandomState(29)
+        n = 16
+        shape = (n, R, NUM_KINDS)
+        out = empty_msgs(shape, 2)
+        typ = np.zeros(shape, np.int32)
+        valid = rng.rand(*shape) < 0.4
+        # Legal outbox types incl. MsgSnap (the object-path split).
+        choices = np.array([T_HB, T_HB_RESP, T_VOTE, T_VOTE_RESP,
+                            T_APP_RESP, T_APP, T_SNAP])
+        typ[valid] = rng.choice(choices, valid.sum())
+        fields = {}
+        for f in ("term", "log_term", "index", "commit", "reject_hint",
+                  "ctx"):
+            fields[f] = rng.randint(0, 1 << 20, shape).astype(np.int32)
+        n_ents = rng.randint(0, 3, shape).astype(np.int32)
+        reject = rng.rand(*shape) < 0.2
+        out = out._replace(
+            valid=jnp.asarray(valid), type=jnp.asarray(typ),
+            reject=jnp.asarray(reject), n_ents=jnp.asarray(n_ents),
+            **{f: jnp.asarray(a) for f, a in fields.items()})
+        slots = rng.randint(0, R, n).astype(np.int32)
+
+        words, simple, cplx = pack_outbox(out, jnp.asarray(slots))
+        rec_pack = compact_records(np.asarray(words), np.asarray(simple))
+
+        class O:  # numpy outbox stand-in for the reference collect
+            pass
+
+        o = O()
+        o.type, o.n_ents, o.reject = typ, n_ents, reject
+        for f, a in fields.items():
+            setattr(o, f, a)
+        blk_ref, cplx_ref = collect_block(valid, o, slots)
+        assert (rec_pack == blk_ref.rec).all()
+        assert (np.asarray(cplx).reshape(shape) == cplx_ref).all()
+        assert (np.asarray(cplx).sum()
+                == (valid & (typ == T_SNAP)).sum())
