@@ -101,6 +101,12 @@ def _rate(eng, props, rounds_per_call: int, calls: int,
 
 def main() -> None:
     _ensure_live_backend()
+    # Transfer sentinel (ISSUE 7): every warm round dispatch runs under
+    # jax.transfer_guard("disallow") — an implicit transfer in the
+    # measured loop is a hard error, not a silent per-round sync that
+    # ships a fake record (the r4 675M/s artifact class). Overhead is
+    # below box noise (BENCH_NOTES r7). Opt out: ETCD_TPU_TRANSFER_GUARD=.
+    os.environ.setdefault("ETCD_TPU_TRANSFER_GUARD", "disallow")
     import jax
 
     from etcd_tpu.batched.compile_cache import enable_compile_cache

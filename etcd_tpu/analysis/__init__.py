@@ -1,0 +1,39 @@
+"""Static analysis + runtime sentinels for the batched hot path (ISSUE 7).
+
+Three planes, one goal — keep the jitted round pure, the dtypes
+disciplined, and the compile budget bounded, mechanically:
+
+* ``jitlint``   — AST lint passes over jit-reachable code (pure stdlib,
+  no jax import): tracer control flow, host syncs inside jit, narrow-lane
+  arithmetic before the mandated widen-at-entry, use-after-donation,
+  banned impurities, dict-order-dependent static args, and per-item
+  device syncs inside host loops. CLI: ``tools/jitlint.py``.
+* ``sentinels`` — runtime guards: ``jax.transfer_guard("disallow")``
+  around the warm round dispatch (ETCD_TPU_TRANSFER_GUARD=disallow) and
+  a recompile sentinel counting distinct round-step programs per session
+  against a declared shape budget (tests/batched/conftest.py).
+* ``lockorder`` — an instrumented ``threading.Lock`` recorder that
+  builds the cross-thread acquisition graph (drain/pump/sender lanes)
+  and fails on cycles.
+
+Everything here is import-light: ``jitlint``/``lockorder`` never import
+jax; ``sentinels`` imports it lazily so the lint CLI runs anywhere.
+"""
+
+from .jitlint import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from .lockorder import LockOrderRecorder, LockOrderViolation  # noqa: F401
+from .sentinels import (  # noqa: F401
+    CompileBudget,
+    RecompileBudgetExceeded,
+    distinct_shapes,
+    note_compile_key,
+    reset_compile_tracking,
+    round_guard,
+    transfer_guard_mode,
+)

@@ -1,0 +1,837 @@
+"""jitlint: AST lint passes for jax hot-path code (pure stdlib).
+
+Every perf regression and measurement artifact this repo has shipped so
+far was a *mechanically detectable* class of bug: a missing fence made a
+675M/s record (BENCH r4), per-message host syncs burned 45% of each
+hosted round (fixed by hand in PR 6), and an accidental Python branch on
+a tracer silently forces a device->host transfer per round. These
+passes encode those classes.
+
+Jit-reachability
+----------------
+A function is a *jit root* when it is decorated with ``jax.jit`` /
+``functools.partial(jax.jit, ...)`` or passed by name to
+``jax.jit`` / ``vmap`` / ``pmap`` / ``lax.scan`` / ``lax.cond`` /
+``lax.while_loop`` / ``lax.fori_loop`` / ``checkpoint`` anywhere in the
+analyzed file set. Reachability then propagates through plain-name
+calls, across files via ``from .mod import name`` within the set.
+
+Device values (syntactic, conservative)
+---------------------------------------
+Inside jit-reachable code: parameters are tracers unless annotated with
+a static type (``bool``/``int``/``float``/``str``/``*Config``) or named
+``self``/``cfg``/``config``; results of ``jnp.*``/``jax.*`` calls are
+device values; devness propagates through arithmetic, comparisons,
+subscripts, attribute access (except the static ``.shape``/``.dtype``/
+``.ndim``/``.size``) and assignment. Container literals are NOT device
+(a Python list of tracers is legal to iterate).
+
+Rules
+-----
+- ``tracer-branch``     Python control flow (if/while/assert/ternary/
+                        and/or/iteration) on a device value in jit code.
+- ``host-sync-in-jit``  ``.item()``/``.tolist()``/``bool()``/``int()``/
+                        ``float()``/``np.*``/``block_until_ready``/
+                        ``device_get`` on a device value in jit code.
+- ``narrow-lane-arith`` arithmetic on a value narrowed to int8/int16,
+                        or narrow-lane state-field access in a jit root
+                        before its ``widen_state`` call.
+- ``donated-use``       a buffer passed at a donated position of a
+                        ``jax.jit(..., donate_argnums=...)`` callable is
+                        read again before being rebound.
+- ``impure-jit``        ``time.*``/``random.*``/``np.random.*``/
+                        ``datetime.*``/``uuid.*``/``secrets.*``/
+                        ``os.urandom`` inside jit code.
+- ``dict-order-static`` a set literal/comprehension or unsorted
+                        ``.keys()/.values()/.items()`` feeding a
+                        ``jax.jit(...)`` call (static-arg hash order).
+- ``sync-in-loop``      ``np.asarray``/``np.array``/``.item()``/
+                        ``block_until_ready``/``device_get`` inside a
+                        for/while loop of HOST code in a jax-importing
+                        module — the per-item-sync class PR 6 spent a
+                        whole tentpole deleting. One bulk gather per
+                        round is the blessed idiom; loops are not.
+
+Waivers
+-------
+Findings are suppressible ONLY via an inline pragma — a comment
+reading ``jitlint: waive(<rule>) -- <reason>`` on the offending line
+or the line directly above.
+
+The reason after ``--`` is mandatory (``waiver-malformed`` otherwise);
+a pragma that suppresses nothing is itself a finding
+(``waiver-unused``), so stale waivers cannot accumulate.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "tracer-branch": (
+        "Python control flow on a device value inside jit-reachable "
+        "code (forces concretization: per-round device->host sync or "
+        "TracerBoolConversionError)"),
+    "host-sync-in-jit": (
+        "host conversion (.item()/.tolist()/bool()/int()/float()/np.*/"
+        "block_until_ready/device_get) on a device value inside "
+        "jit-reachable code"),
+    "narrow-lane-arith": (
+        "arithmetic on an int8/int16-narrowed value (narrow lanes are "
+        "storage-only: widen to i32 at kernel entry before any math, "
+        "else the win silently becomes wrap-around bugs)"),
+    "donated-use": (
+        "use of a buffer after passing it at a donated position "
+        "(donated buffers are freed by XLA; reading one is "
+        "use-after-free at the runtime's mercy)"),
+    "impure-jit": (
+        "impure call (time/random/datetime/uuid/secrets/os.urandom) "
+        "inside jit-reachable code (baked in at trace time, silently "
+        "constant thereafter)"),
+    "dict-order-static": (
+        "dict/set iteration order feeding a jax.jit static argument "
+        "(hash-order differences recompile per process and blow the "
+        "compile budget)"),
+    "sync-in-loop": (
+        "device sync (np.asarray/np.array/.item()/block_until_ready/"
+        "device_get) inside a host loop — sync once in bulk per round, "
+        "not per item"),
+    "waiver-malformed": (
+        "jitlint waive pragma without a ' -- <reason>' justification"),
+    "waiver-unused": (
+        "jitlint waive pragma that suppresses no finding (stale — "
+        "remove it)"),
+    "syntax-error": (
+        "file failed to parse — nothing else can be checked"),
+}
+
+_JIT_WRAPPERS = {
+    "jit", "vmap", "pmap", "scan", "cond", "while_loop", "fori_loop",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "switch",
+}
+_STATIC_PARAM_NAMES = {"self", "cls", "cfg", "config"}
+_STATIC_ANNOTATIONS = {"bool", "int", "float", "str", "bytes"}
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+_NARROW_CASTS = {"int8", "int16", "I8", "I16", "i8", "i16"}
+# Mirrors state.NARROW_DTYPES (kept literal: jitlint imports nothing
+# from the package it lints).
+NARROW_FIELDS = {
+    "role", "vote", "lead", "transferee", "votes", "pr_state", "inflight",
+}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_IMPURE_PREFIXES = (
+    "time.", "random.", "np.random.", "numpy.random.", "datetime.",
+    "uuid.", "secrets.",
+)
+_IMPURE_EXACT = {"os.urandom", "time", "input"}
+
+_WAIVE_RE = re.compile(
+    r"#\s*jitlint:\s*waive\(([^)]*)\)(?:\s*--\s*(\S.*))?")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    waived: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        tag = f" [waived: {self.reason}]" if self.waived else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{tag}"
+
+
+@dataclass
+class _Waiver:
+    line: int  # line the pragma suppresses findings on
+    rules: Set[str]
+    reason: str
+    pragma_line: int
+    used: bool = False
+
+
+@dataclass(eq=False)
+class _FuncRec:
+    module: str
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    jit_root: bool = False
+    reachable: bool = False
+    calls: Set[str] = field(default_factory=set)  # local names called
+
+
+@dataclass(eq=False)
+class _ModRec:
+    name: str
+    path: str
+    tree: ast.Module
+    source_lines: List[str]
+    imports_jax: bool = False
+    # local name -> (module, name) for `from .mod import name`
+    import_map: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    funcs: Dict[str, _FuncRec] = field(default_factory=dict)  # by simple name
+    waivers: List[_Waiver] = field(default_factory=list)
+    findings: List[Finding] = field(default_factory=list)
+    # callable-name -> donated positional indexes
+    donators: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _parse_waivers(source: str, path: str,
+                   findings: List[Finding]) -> List[_Waiver]:
+    """Scan COMMENT tokens (only — string literals that merely mention
+    the pragma syntax, like this module's own docs, never match)."""
+    import io
+    import tokenize
+
+    waivers: List[_Waiver] = []
+    lines = source.splitlines()
+    comments: List[Tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError):
+        pass
+    for i, text in comments:
+        m = _WAIVE_RE.search(text)
+        if not m:
+            if "jitlint:" in text and "waive(" in text:
+                findings.append(Finding(
+                    path, i, "waiver-malformed",
+                    "unparseable jitlint pragma (expected "
+                    "'# jitlint: waive(<rule>) -- <reason>')"))
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = (m.group(2) or "").strip()
+        unknown = rules - set(RULES)
+        if unknown or not rules:
+            findings.append(Finding(
+                path, i, "waiver-malformed",
+                f"unknown rule(s) in waive pragma: {sorted(unknown)}"))
+            rules &= set(RULES)
+            if not rules:
+                # Nothing left to waive — don't also append an empty
+                # waiver that waiver-unused would re-report as noise.
+                continue
+        if not reason:
+            findings.append(Finding(
+                path, i, "waiver-malformed",
+                "waive pragma missing ' -- <reason>' justification"))
+            continue
+        # A standalone comment line waives the next non-comment line;
+        # a trailing pragma waives its own line.
+        target = i
+        full_line = lines[i - 1] if i <= len(lines) else ""
+        if full_line.lstrip().startswith("#"):
+            target = i + 1
+            while target <= len(lines) and (
+                    not lines[target - 1].strip()
+                    or lines[target - 1].lstrip().startswith("#")):
+                target += 1
+        waivers.append(_Waiver(target, rules, reason, i))
+    return waivers
+
+
+# -----------------------------------------------------------------------------
+# Module collection
+# -----------------------------------------------------------------------------
+
+
+def _collect_module(path: str, source: str) -> _ModRec:
+    tree = ast.parse(source, filename=path)
+    name = os.path.splitext(os.path.basename(path))[0]
+    rec = _ModRec(name, path, tree, source.splitlines())
+    rec.waivers = _parse_waivers(source, path, rec.findings)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax" or a.name.startswith("jax."):
+                    rec.imports_jax = True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                rec.imports_jax = True
+            base = mod.rsplit(".", 1)[-1] if mod else ""
+            for a in node.names:
+                rec.import_map[a.asname or a.name] = (base, a.name)
+
+    def collect_funcs(body, prefix: str):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{node.name}"
+                fr = _FuncRec(rec.name, qual, node)
+                # Simple-name index: inner defs shadow outers only if
+                # duplicate names collide, which is fine for our use.
+                rec.funcs.setdefault(node.name, fr)
+                for d in node.decorator_list:
+                    dd = _dotted(d) or ""
+                    if isinstance(d, ast.Call):
+                        dd = _dotted(d.func) or ""
+                        for sub in ast.walk(d):
+                            sdd = _dotted(sub) if isinstance(
+                                sub, (ast.Name, ast.Attribute)) else None
+                            if sdd and sdd.split(".")[-1] in _JIT_WRAPPERS:
+                                fr.jit_root = True
+                    if dd.split(".")[-1] in _JIT_WRAPPERS:
+                        fr.jit_root = True
+                collect_funcs(node.body, f"{qual}.<locals>.")
+            elif isinstance(node, (ast.ClassDef,)):
+                collect_funcs(node.body, f"{prefix}{node.name}.")
+            elif hasattr(node, "body") and isinstance(node.body, list):
+                # Generic statement containers (if/try/with/for/while
+                # and their else/finally/except blocks).
+                collect_funcs(node.body, prefix)
+                for attr in ("orelse", "finalbody", "handlers"):
+                    for sub in getattr(node, attr, []) or []:
+                        if hasattr(sub, "body"):
+                            collect_funcs(sub.body, prefix)
+                        else:
+                            collect_funcs([sub], prefix)
+
+    collect_funcs(tree.body, "")
+
+    # Functions passed by name to jit wrappers are roots; assignments of
+    # jax.jit(...) results record donation signatures.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn_dd = _dotted(node.func) or ""
+        leaf = fn_dd.split(".")[-1]
+        if leaf in _JIT_WRAPPERS:
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in rec.funcs:
+                    rec.funcs[arg.id].jit_root = True
+        if leaf == "jit":
+            donate: Tuple[int, ...] = ()
+            for kw in node.keywords:
+                if kw.arg in ("donate_argnums", "donate_argnames"):
+                    try:
+                        vals = ast.literal_eval(kw.value)
+                    except ValueError:
+                        vals = None
+                    if isinstance(vals, int):
+                        donate = (vals,)
+                    elif isinstance(vals, (tuple, list)):
+                        donate = tuple(v for v in vals if isinstance(v, int))
+            if donate:
+                parent_assigns = _assign_targets_of_call(tree, node)
+                for tgt in parent_assigns:
+                    rec.donators[tgt.split(".")[-1]] = donate
+
+    # Call edges (simple names only).
+    for fr in set(rec.funcs.values()):
+        for sub in ast.walk(fr.node):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                fr.calls.add(sub.func.id)
+            elif isinstance(sub, ast.Call):
+                dd = _dotted(sub.func)
+                if dd:
+                    fr.calls.add(dd.split(".")[-1])
+    return rec
+
+
+def _assign_targets_of_call(tree: ast.Module, call: ast.Call) -> List[str]:
+    """Dotted names an expression is assigned to (scan for Assign whose
+    value subtree contains `call`)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                sub is call for sub in ast.walk(node.value)):
+            for t in node.targets:
+                dd = _dotted(t)
+                if dd:
+                    out.append(dd)
+    return out
+
+
+def _propagate_reachability(mods: Dict[str, _ModRec]) -> None:
+    # Import links resolve by module NAME; records are keyed by path
+    # (stems can collide across directories — first record wins links).
+    by_name: Dict[str, _ModRec] = {}
+    owner: Dict[_FuncRec, _ModRec] = {}
+    work: List[_FuncRec] = []
+    for m in mods.values():
+        by_name.setdefault(m.name, m)
+        for fr in set(m.funcs.values()):
+            owner[fr] = m
+            if fr.jit_root and not fr.reachable:
+                fr.reachable = True
+                work.append(fr)
+    while work:
+        fr = work.pop()
+        m = owner[fr]
+        for callee in fr.calls:
+            targets: List[_FuncRec] = []
+            if callee in m.funcs:
+                targets.append(m.funcs[callee])
+            elif callee in m.import_map:
+                im, iname = m.import_map[callee]
+                if im in by_name and iname in by_name[im].funcs:
+                    targets.append(by_name[im].funcs[iname])
+            for t in targets:
+                if not t.reachable:
+                    t.reachable = True
+                    work.append(t)
+
+
+# -----------------------------------------------------------------------------
+# Devness inference + per-function rule visitors
+# -----------------------------------------------------------------------------
+
+
+class _FuncLinter:
+    def __init__(self, mod: _ModRec, fr: _FuncRec, jit: bool):
+        self.mod = mod
+        self.fr = fr
+        self.jit = jit  # jit-reachable scope
+        self.device: Set[str] = set()
+        self.narrow: Set[str] = set()
+        self.findings = mod.findings
+        if jit:
+            self._seed_params()
+
+    def _emit(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(Finding(
+            self.mod.path, getattr(node, "lineno", 0), rule, msg))
+
+    def _seed_params(self) -> None:
+        args = self.fr.node.args
+        all_args = (args.posonlyargs + args.args + args.kwonlyargs
+                    + ([args.vararg] if args.vararg else [])
+                    + ([args.kwarg] if args.kwarg else []))
+        for a in all_args:
+            if a.arg in _STATIC_PARAM_NAMES:
+                continue
+            if a.annotation is not None:
+                ann = ast.unparse(a.annotation)
+                leaf = ann.split(".")[-1].split("[")[0]
+                if leaf in _STATIC_ANNOTATIONS or leaf.endswith("Config"):
+                    continue
+            self.device.add(a.arg)
+
+    # -- devness --------------------------------------------------------------
+
+    def is_device(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.device
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_device(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_device(node.value) or self.is_device(node.slice)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_device(node.left) or self.is_device(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_device(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.is_device(node.left) or any(
+                self.is_device(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_device(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.is_device(node.body) or self.is_device(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self.is_device(node.value)
+        if isinstance(node, ast.Call):
+            dd = _dotted(node.func) or ""
+            root = dd.split(".")[0]
+            if root in ("jnp", "lax") or dd.startswith((
+                    "jax.numpy.", "jax.lax.", "jax.nn.", "jax.tree")):
+                return True
+            if dd in ("jax.device_put",):
+                return True
+            # Method chains on device values (.astype/.at[..].set/...)
+            # and calls forwarding device arguments stay device.
+            if isinstance(node.func, ast.Attribute) and self.is_device(
+                    node.func.value):
+                return node.func.attr not in _SYNC_METHODS
+            if root in ("np", "numpy", "bool", "int", "float", "len"):
+                return False
+            return any(self.is_device(a) for a in node.args) or any(
+                self.is_device(k.value) for k in node.keywords)
+        return False
+
+    def _is_narrow(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.narrow
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr == "astype":
+            if node.args:
+                cast = ast.unparse(node.args[0]).split(".")[-1]
+                if cast in _NARROW_CASTS:
+                    return True
+        if isinstance(node, ast.Call):
+            dd = _dotted(node.func) or ""
+            if dd.split(".")[-1] == "narrow_state":
+                return True
+        return False
+
+    # -- the pass -------------------------------------------------------------
+
+    def run(self) -> None:
+        node = self.fr.node
+        if self.jit:
+            # Two passes: devness propagates through assignments that
+            # lexically precede their uses on pass 1; pass 2 catches
+            # the rest (closures over later defs are rare in jit code).
+            for _ in range(2):
+                for stmt in ast.walk(node):
+                    if isinstance(stmt, ast.Assign):
+                        dev = self.is_device(stmt.value)
+                        nar = self._is_narrow(stmt.value)
+                        for t in stmt.targets:
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name):
+                                    if dev:
+                                        self.device.add(n.id)
+                                    if nar:
+                                        self.narrow.add(n.id)
+                                    elif n.id in self.narrow:
+                                        self.narrow.discard(n.id)
+                    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                        tgt = stmt.target
+                        if isinstance(tgt, ast.Name) and stmt.value is not None \
+                                and self.is_device(stmt.value):
+                            self.device.add(tgt.id)
+            self._check_jit_rules(node)
+            self._check_widen_discipline(node)
+        else:
+            self._check_host_rules(node)
+        self._check_donated_use(node)
+
+    def _check_jit_rules(self, fn_node: ast.AST) -> None:
+        own_nested = {
+            n for n in ast.walk(fn_node)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn_node
+        }
+
+        def in_nested(node):
+            return any(node in ast.walk(f) for f in own_nested)
+
+        for node in ast.walk(fn_node):
+            # Nested defs are linted as their own (reachable) functions.
+            if node is not fn_node and in_nested(node):
+                continue
+            if isinstance(node, (ast.If, ast.While)) and self.is_device(
+                    node.test):
+                self._emit(node, "tracer-branch",
+                           f"`{ast.unparse(node.test)[:60]}` is a device "
+                           "value; use jnp.where/lax.cond")
+            elif isinstance(node, ast.IfExp) and self.is_device(node.test):
+                self._emit(node, "tracer-branch",
+                           "ternary on a device value; use jnp.where")
+            elif isinstance(node, ast.Assert) and self.is_device(node.test):
+                self._emit(node, "tracer-branch",
+                           "assert on a device value (concretizes); use "
+                           "checkify or a static shape check")
+            elif isinstance(node, ast.BoolOp) and self.is_device(node):
+                self._emit(node, "tracer-branch",
+                           "and/or on device values calls bool(); "
+                           "use & / | / jnp.logical_*")
+            elif isinstance(node, ast.For) and self.is_device(node.iter):
+                self._emit(node, "tracer-branch",
+                           "iteration over a device value; use lax.scan "
+                           "or index with a static range")
+            elif isinstance(node, ast.comprehension) and self.is_device(
+                    node.iter):
+                self._emit(node, "tracer-branch",
+                           "comprehension over a device value")
+            elif isinstance(node, ast.Call):
+                self._check_jit_call(node)
+            elif isinstance(node, ast.BinOp):
+                for side in (node.left, node.right):
+                    if self._is_narrow(side):
+                        self._emit(
+                            node, "narrow-lane-arith",
+                            "arithmetic on an int8/int16-narrowed value; "
+                            "widen to i32 first (state.widen_state / "
+                            ".astype(I32))")
+                        break
+
+    def _check_jit_call(self, node: ast.Call) -> None:
+        dd = _dotted(node.func) or ""
+        leaf = dd.split(".")[-1]
+        if dd.startswith(_IMPURE_PREFIXES) or dd in _IMPURE_EXACT:
+            self._emit(node, "impure-jit",
+                       f"`{dd}(...)` inside jit-reachable code")
+            return
+        args_dev = any(self.is_device(a) for a in node.args)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS and \
+                self.is_device(node.func.value):
+            self._emit(node, "host-sync-in-jit",
+                       f".{node.func.attr}() on a device value inside "
+                       "jit-reachable code")
+        elif dd in ("bool", "int", "float") and args_dev:
+            self._emit(node, "host-sync-in-jit",
+                       f"{dd}() on a device value inside jit-reachable "
+                       "code (concretizes the tracer)")
+        elif (dd.split(".")[0] in ("np", "numpy")
+              and not dd.startswith(("np.random", "numpy.random"))
+              and args_dev):
+            self._emit(node, "host-sync-in-jit",
+                       f"`{dd}(...)` on a device value inside "
+                       "jit-reachable code (numpy pulls the tracer to "
+                       "host); use jnp")
+        elif leaf == "device_get" and args_dev:
+            self._emit(node, "host-sync-in-jit",
+                       "jax.device_get inside jit-reachable code")
+
+    def _check_widen_discipline(self, fn_node: ast.AST) -> None:
+        """In a jit ROOT with a BatchedState-annotated param, narrow
+        state fields must not be touched before widen_state runs (the
+        widen-at-entry contract that keeps cfg.narrow_lanes safe)."""
+        if not self.fr.jit_root:
+            return
+        args = self.fr.node.args
+        state_params = {
+            a.arg for a in args.posonlyargs + args.args + args.kwonlyargs
+            if a.annotation is not None
+            and ast.unparse(a.annotation).split(".")[-1] == "BatchedState"
+        }
+        if not state_params:
+            return
+        widened = False
+        for stmt in getattr(fn_node, "body", []):
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    dd = _dotted(sub.func) or ""
+                    if dd.split(".")[-1] == "widen_state":
+                        widened = True
+                if (not widened and isinstance(sub, ast.Attribute)
+                        and sub.attr in NARROW_FIELDS
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id in state_params):
+                    self._emit(
+                        sub, "narrow-lane-arith",
+                        f"narrow lane `.{sub.attr}` read in a jit root "
+                        "before widen_state (storage may be int8/int16 "
+                        "under cfg.narrow_lanes)")
+            if widened:
+                break
+
+    def _check_host_rules(self, fn_node: ast.AST) -> None:
+        if not self.mod.imports_jax:
+            return
+        loops = [n for n in ast.walk(fn_node)
+                 if isinstance(n, (ast.For, ast.While))]
+        seen: Set[int] = set()
+        for loop in loops:
+            for node in ast.walk(loop):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                dd = _dotted(node.func) or ""
+                hit = (
+                    dd in ("np.asarray", "np.array", "numpy.asarray",
+                           "numpy.array", "jax.device_get",
+                           "jax.block_until_ready")
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("item", "block_until_ready"))
+                )
+                if hit:
+                    seen.add(id(node))
+                    self._emit(
+                        node, "sync-in-loop",
+                        f"`{(dd or node.func.attr)}` inside a host loop; "
+                        "hoist to one bulk sync per round")
+
+    def _check_donated_use(self, fn_node: ast.AST) -> None:
+        donators = self.mod.donators
+        if not donators:
+            return
+        stmts = list(ast.walk(fn_node))
+        for node in stmts:
+            if not isinstance(node, ast.Call):
+                continue
+            dd = _dotted(node.func) or ""
+            leaf = dd.split(".")[-1]
+            if leaf not in donators:
+                continue
+            for pos in donators[leaf]:
+                if pos >= len(node.args):
+                    continue
+                arg_dd = _dotted(node.args[pos])
+                if not arg_dd:
+                    continue
+                self._flag_use_after(fn_node, node, arg_dd)
+
+    def _flag_use_after(self, fn_node: ast.AST, call: ast.Call,
+                        name: str) -> None:
+        call_line = call.lineno
+        rebound_line = None
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and node.lineno >= call_line:
+                for t in node.targets:
+                    for sub in ast.walk(t):
+                        dd = _dotted(sub) if isinstance(
+                            sub, (ast.Name, ast.Attribute)) else None
+                        if dd == name:
+                            rebound_line = min(
+                                rebound_line or node.lineno, node.lineno)
+        for node in ast.walk(fn_node):
+            dd = _dotted(node) if isinstance(
+                node, (ast.Name, ast.Attribute)) else None
+            if dd != name or not isinstance(
+                    getattr(node, "ctx", None), ast.Load):
+                continue
+            line = node.lineno
+            if line <= call_line:
+                continue
+            if rebound_line is not None and line >= rebound_line:
+                continue
+            self._emit(node, "donated-use",
+                       f"`{name}` read after being donated at line "
+                       f"{call_line} (buffer freed by XLA)")
+            return
+
+
+def _check_dict_order_static(mod: _ModRec) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dd = _dotted(node.func) or ""
+        if dd.split(".")[-1] != "jit":
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            for sub in ast.walk(arg):
+                bad = None
+                if isinstance(sub, (ast.Set, ast.SetComp)):
+                    bad = "set literal/comprehension"
+                elif isinstance(sub, ast.Call):
+                    sdd = _dotted(sub.func) or ""
+                    if sdd == "set":
+                        bad = "set(...)"
+                    elif isinstance(sub.func, ast.Attribute) and \
+                            sub.func.attr in ("keys", "values", "items"):
+                        bad = f".{sub.func.attr}()"
+                if bad and not _sorted_wrapped(arg, sub):
+                    mod.findings.append(Finding(
+                        mod.path, sub.lineno, "dict-order-static",
+                        f"{bad} feeding jax.jit — iteration order is "
+                        "not canonical; wrap in sorted(...) or use a "
+                        "tuple literal"))
+
+
+def _sorted_wrapped(root: ast.AST, target: ast.AST) -> bool:
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            if (_dotted(node.func) or "") == "sorted":
+                if any(sub is target for sub in ast.walk(node)):
+                    return True
+    return False
+
+
+# -----------------------------------------------------------------------------
+# Entry points
+# -----------------------------------------------------------------------------
+
+
+def _apply_waivers(mod: _ModRec) -> None:
+    for f in mod.findings:
+        if f.rule.startswith("waiver-"):
+            continue
+        for w in mod.waivers:
+            if w.line == f.line and f.rule in w.rules:
+                f.waived = True
+                f.reason = w.reason
+                w.used = True
+                break
+    for w in mod.waivers:
+        if not w.used:
+            mod.findings.append(Finding(
+                mod.path, w.pragma_line, "waiver-unused",
+                f"waive({', '.join(sorted(w.rules))}) suppresses "
+                "nothing on its target line"))
+
+
+def lint_modules(mods: Dict[str, _ModRec]) -> List[Finding]:
+    _propagate_reachability(mods)
+    for mod in mods.values():
+        for fr in set(mod.funcs.values()):
+            _FuncLinter(mod, fr, jit=fr.reachable).run()
+        _check_dict_order_static(mod)
+        _apply_waivers(mod)
+    out: List[Finding] = []
+    for mod in mods.values():
+        out.extend(mod.findings)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def lint_source(source: str, path: str = "<string>",
+                extra_modules: Optional[Dict[str, str]] = None
+                ) -> List[Finding]:
+    """Lint one source string (tests); `extra_modules` maps module name
+    -> source for cross-file reachability."""
+    mods = {}
+    rec = _collect_module(path, source)
+    mods[rec.path] = rec
+    for name, src in (extra_modules or {}).items():
+        extra = _collect_module(f"<{name}>", src)
+        extra.name = name
+        mods[extra.path] = extra
+    return [f for f in lint_modules(mods) if f.path == path]
+
+
+def lint_file(path: str) -> List[Finding]:
+    return lint_paths([path])
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for base, _dirs, names in os.walk(p):
+                files.extend(
+                    os.path.join(base, n) for n in sorted(names)
+                    if n.endswith(".py"))
+        elif p.endswith(".py") and os.path.isfile(p):
+            files.append(p)
+        else:
+            # A typo'd/renamed path must FAIL the gate, not lint zero
+            # files and exit green — a vacuous gate is worse than a
+            # broken one.
+            raise FileNotFoundError(
+                f"jitlint: not a directory or existing .py file: {p!r}")
+    return files
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    mods: Dict[str, _ModRec] = {}
+    for path in collect_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            rec = _collect_module(path, source)
+        except SyntaxError as e:
+            rec = _ModRec(os.path.basename(path), path,
+                          ast.Module(body=[], type_ignores=[]), [])
+            rec.findings.append(Finding(
+                path, e.lineno or 0, "syntax-error",
+                f"syntax error: {e.msg}"))
+        # Keyed by PATH (stems collide across dirs, e.g. tools/x.py vs
+        # pkg/x.py); lint_modules builds its own name index for import
+        # resolution.
+        mods[rec.path] = rec
+    return lint_modules(mods)

@@ -14,6 +14,7 @@ server/etcdserver/raft.go:158-315).
 
 from __future__ import annotations
 
+import itertools
 from collections import deque
 from typing import Optional
 
@@ -21,7 +22,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.sentinels import note_compile_key, warm_guard
 from .compile_cache import enable_compile_cache
+
+# Never-reused engine identity for transfer-guard warm keys (itertools
+# .count is atomic under the GIL).
+_ENGINE_SERIAL = itertools.count()
 from .state import BatchedConfig, BatchedState, init_state, LEADER, I32
 from .step import MsgSlots, NUM_KINDS, empty_msgs, make_step_round, route
 
@@ -81,6 +87,17 @@ class MultiRaftEngine:
         self._closed_loop = jax.jit(
             closed_loop, static_argnames=("rounds",), donate_argnums=(0, 1)
         )
+        note_compile_key("closed_loop", f"{cfg}")
+        # Transfer-guard warm keys (analysis.sentinels): the guard wraps
+        # dispatch only AFTER a (program, statics) pair has compiled
+        # once — compilation legitimately transfers host constants. The
+        # round program is shared per config (step._step_round_jit), so
+        # its warmth is keyed by config, not engine identity; the
+        # per-engine closed-loop wrapper is keyed by a monotonic serial
+        # (NOT id(self): CPython reuses freed addresses, and a stale
+        # warm key would put a new engine's compile inside the guard).
+        self._wkey_step = f"round_step/{hash((cfg, False, n))}"
+        self._serial = next(_ENGINE_SERIAL)
 
     # -- driving --------------------------------------------------------------
 
@@ -103,16 +120,20 @@ class MultiRaftEngine:
         camp = campaign_mask if campaign_mask is not None else self._zeros_b
         props = propose_n if propose_n is not None else self._zeros_i
         iso = isolate if isolate is not None else self._zeros_b
-        out = self._step(
-            self.state, self.inbox, ticks, camp, props, iso,
-            transfer_to, read_req,
-        )
-        self.state, outbox = out[:2]
-        if self.cfg.telemetry:
-            fr = out[-1]
-            self._tel_counters = self._tel_counters + fr.counters
-            self._tel_invariants = self._tel_invariants | fr.invariants
-        self.inbox = route(self.cfg, outbox)
+        # Inside the guard the dispatch must be all-device: any implicit
+        # transfer (an eager scalar op, a stray host array) is a hard
+        # error when ETCD_TPU_TRANSFER_GUARD=disallow (tests, benches).
+        with warm_guard(self._wkey_step):
+            out = self._step(
+                self.state, self.inbox, ticks, camp, props, iso,
+                transfer_to, read_req,
+            )
+            self.state, outbox = out[:2]
+            if self.cfg.telemetry:
+                fr = out[-1]
+                self._tel_counters = self._tel_counters + fr.counters
+                self._tel_invariants = self._tel_invariants | fr.invariants
+            self.inbox = route(self.cfg, outbox)
 
     def _tel(self):
         """Telemetry carry for the closed loop (empty pytree when off)."""
@@ -130,9 +151,12 @@ class MultiRaftEngine:
         device (one fused lax.scan program)."""
         ticks = jnp.ones_like(self._zeros_b) if tick else self._zeros_b
         props = propose_n if propose_n is not None else self._zeros_i
-        self.state, self.inbox, tel, _ = self._closed_loop(
-            self.state, self.inbox, ticks, props, self._tel(), rounds
-        )
+        # `rounds` is a static arg: each new value compiles a new scan
+        # program, so warmth (and thus the transfer guard) is per value.
+        with warm_guard(f"closed_loop/{self._serial}/{rounds}"):
+            self.state, self.inbox, tel, _ = self._closed_loop(
+                self.state, self.inbox, ticks, props, self._tel(), rounds
+            )
         self._set_tel(tel)
 
     def run_rounds_pipelined(self, rounds: int, chunk: int = 16,
@@ -161,13 +185,15 @@ class MultiRaftEngine:
         done = 0
         while done < rounds:
             n = min(chunk, rounds - done)
-            self.state, self.inbox, tel, fence = self._closed_loop(
-                self.state, self.inbox, ticks, props, self._tel(), n
-            )
+            with warm_guard(f"closed_loop/{self._serial}/{n}"):
+                self.state, self.inbox, tel, fence = self._closed_loop(
+                    self.state, self.inbox, ticks, props, self._tel(), n
+                )
             self._set_tel(tel)
             done += n
             fences.append(fence)
             while len(fences) > depth:
+                # jitlint: waive(sync-in-loop) -- the sync IS the pipelining contract: block on the per-chunk scalar fence to bound queue depth at `depth` without holding a donated buffer
                 jax.block_until_ready(fences.popleft())
 
     def campaign(self, instance_ids) -> None:

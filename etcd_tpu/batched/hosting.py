@@ -701,6 +701,7 @@ class MultiRaftMember:
                          "last_term": wma[:, 2], "commit": wma[:, 3]}))
             for rd in batch:
                 if rd.hardstates:
+                    # jitlint: waive(sync-in-loop) -- rd.hardstates is a host list (no device buffer); one pack per Ready of the drain batch, bounded by batch depth
                     hsa = np.array(rd.hardstates, np.int64)
                     self.wal.append(RT_HS_BATCH, _pack_rows(
                         WAL_HS_DTYPE,

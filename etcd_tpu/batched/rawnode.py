@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.sentinels import warm_guard
 from ..raft.types import (
     Entry,
     EntryType,
@@ -252,6 +253,10 @@ class BatchedRawNode:
         self._step = make_step_round(
             cfg, iids=dev(iids), slots=self._slots_j, with_aux=True,
         )
+        # Transfer-guard warmth is per (config, row count): the shared
+        # round program recompiles per distinct row shape, and compiles
+        # must run unguarded (they transfer host constants).
+        self._wkey_step = f"round_step/{hash((cfg, True, self.n))}"
 
         self.state = init_state(cfg, start_index, iids=jnp.asarray(iids))
         if self._shard is not None:
@@ -663,21 +668,27 @@ class BatchedRawNode:
                 send_append=st0.send_append.at[jnp.asarray(poke_rows)]
                 .set(True)
             )
-        step_out = self._step(
-            self.state, inbox,
+        # Host->device staging happens OUTSIDE the transfer guard (it
+        # is the intended, bulk transfer of the round); the guarded
+        # region below is then pure warm device dispatch, where any
+        # implicit transfer is a smuggled per-round sync and fails hard
+        # under ETCD_TPU_TRANSFER_GUARD=disallow (analysis.sentinels).
+        dev_in = (
             self._dev(ticks), self._dev(camp),
             self._dev(props_n), self._dev(iso),
             self._dev(transfer), self._dev(read_req),
         )
-        st, outbox, aux = step_out[:3]
-        frame = step_out[3] if cfg.telemetry else None
-        self.state = st
-        # On-device outbox packing: a tiny second program turns the
-        # [n, R, K] outbox fields into wire-width record words (rows of
-        # msgblock.REC_DTYPE bytes) plus block/object masks, so the
-        # host-side collect below is one view-cast + boolean take
-        # instead of 14 fancy-indexed gathers.
-        words_d, simple_d, cplx_d = pack_outbox(outbox, self._slots_j)
+        with warm_guard(self._wkey_step):
+            step_out = self._step(self.state, inbox, *dev_in)
+            st, outbox, aux = step_out[:3]
+            frame = step_out[3] if cfg.telemetry else None
+            self.state = st
+            # On-device outbox packing: a tiny second program turns the
+            # [n, R, K] outbox fields into wire-width record words (rows
+            # of msgblock.REC_DTYPE bytes) plus block/object masks, so
+            # the host-side collect below is one view-cast + boolean
+            # take instead of 14 fancy-indexed gathers.
+            words_d, simple_d, cplx_d = pack_outbox(outbox, self._slots_j)
 
         # Device→host reads go through np.asarray, NOT jax.device_get:
         # this build's device_get pays a fixed ~4ms per buffer (measured

@@ -46,6 +46,7 @@ from .kernels import (
     ring_write_masked,
     term_at,
 )
+from ..analysis.sentinels import note_compile_key
 from .telemetry import NUM_COUNTERS
 from .state import (
     CANDIDATE,
@@ -264,7 +265,7 @@ def _record_vote_and_tally(st: BatchedState, from_slot, granted):
     return st, joint_vote_result(votes, st.voter, st.voter_out, st.in_joint)
 
 
-def _campaign(cfg: BatchedConfig, st: BatchedState, iid, slot, pre,
+def _campaign(cfg: BatchedConfig, st: BatchedState, iid, slot, pre: bool,
               transfer: bool = False) -> BatchedState:
     """ref: raft.go:785-835; `pre`/`transfer` are static bools
     (config.pre_vote; campaignTransfer skips pre-vote and marks its
@@ -1237,6 +1238,10 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
     node with the same config, whatever rows it hosts (iids/slots are
     runtime arguments, so three hosting processes' nodes reuse one
     compilation per shape)."""
+    # Recompile sentinel: one key per distinct round-step program this
+    # session (the lru_cache means this runs once per config). The
+    # tier-1 shape budget in tests/batched/conftest.py audits this set.
+    note_compile_key("round_step", f"{cfg}|aux={int(with_aux)}")
 
     def step_round(st: BatchedState, inbox: MsgSlots, tick_mask, campaign_mask,
                    propose_n, isolate, transfer_to, read_req, iids, slots):

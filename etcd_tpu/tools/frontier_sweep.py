@@ -82,6 +82,7 @@ def _pipeline_gate(merged: bool) -> None:
     for _ in range(48):
         b.step_round(tick=True, propose_n=props)
     for f in ("term", "role", "lead", "commit", "last"):
+        # jitlint: waive(sync-in-loop) -- differential gate, not a hot path: one bulk gather per state field (5 total) to compare pipelined vs serial stepping
         av, bv = np.asarray(getattr(a.state, f)), np.asarray(
             getattr(b.state, f))
         assert (av == bv).all(), (

@@ -51,6 +51,9 @@ def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["ETCD_TPU_PROF"] = "1"
+    # Transfer sentinel (ISSUE 7): worker round dispatch fails hard on
+    # any implicit transfer instead of silently syncing per round.
+    env.setdefault("ETCD_TPU_TRANSFER_GUARD", "disallow")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))

@@ -1,0 +1,58 @@
+"""Batched-suite configuration: runtime sentinels (ISSUE 7).
+
+Two session-wide guards ride every test in this directory:
+
+* **Transfer guard** — ETCD_TPU_TRANSFER_GUARD=disallow makes every
+  warm engine/rawnode round dispatch run under
+  ``jax.transfer_guard("disallow")`` (see analysis/sentinels.round_guard
+  and the warm_guard call sites in engine.py/rawnode.py): an implicit
+  transfer smuggled into the steady-state loop — an eager scalar op, a
+  concretized tracer — fails the test instead of shipping as a silent
+  per-round sync (the BENCH r4 675M/s artifact class).
+
+* **Compile-shape budget** — the declared number of distinct
+  round-step programs (config x aux variants, counted by
+  step._step_round_jit via analysis.sentinels) a full batched-suite
+  session may build. Tier-1 runs within ~15s of its 870s timeout
+  (ROADMAP), and every additional config is a fresh trace+compile, so
+  a PR that adds one must bump this number CONSCIOUSLY — with the
+  tier-1 margin re-checked — rather than discover the truncation line
+  moved. Sharing an existing module's config is free; a novel config
+  costs budget.
+"""
+
+import os
+
+import pytest
+
+# Must be set before any engine dispatches; harmless for processes that
+# never read it. Member subprocesses (hosting_proc / e2e tests) inherit
+# it, so the guard also covers the multi-process hosting path.
+os.environ.setdefault("ETCD_TPU_TRANSFER_GUARD", "disallow")
+
+# The declared tier-1 compile-shape budget for the round-step program.
+# Measured on this tree: a full `pytest tests/batched` session builds
+# 17 distinct (config, aux) round programs; headroom of 3 absorbs
+# parametrization drift without hiding a real regression class (one
+# accidental config fork per PR compounds into minutes of compile).
+ROUND_STEP_SHAPE_BUDGET = 20
+
+
+@pytest.fixture(scope="session", autouse=True)
+def compile_shape_budget_sentinel():
+    """Fail the session when the suite built more distinct round-step
+    programs than declared above (the recompile sentinel's session
+    face; per-wrapper cache-miss counting lives in
+    analysis.sentinels.CompileBudget)."""
+    yield
+    from etcd_tpu.analysis import sentinels
+
+    used = sentinels.distinct_shapes("round_step")
+    if used > ROUND_STEP_SHAPE_BUDGET:
+        keys = "\n  ".join(sorted(sentinels.compile_keys("round_step")))
+        pytest.fail(
+            f"compile-shape budget exceeded: {used} distinct round-step "
+            f"programs > declared {ROUND_STEP_SHAPE_BUDGET} "
+            f"(tests/batched/conftest.py). Share an existing config or "
+            f"bump the budget consciously — tier-1 runs ~15s from its "
+            f"timeout and every config is a fresh compile.\n  {keys}")

@@ -17,6 +17,7 @@ import time
 
 import pytest
 
+from etcd_tpu.analysis.lockorder import LockOrderRecorder
 from etcd_tpu.batched.faults import (
     ChaosHarness,
     FaultSpec,
@@ -205,6 +206,38 @@ class TestTornTail:
         finally:
             obs.stop()
             h.stop()
+
+
+class TestLockOrder:
+    def test_no_lock_order_cycles_across_chaos_threads(self, tmp_path):
+        """ISSUE 7 lock-order sentinel over the REAL thread soup: every
+        lock the hosting/chaos stack creates (member round threads, WAL
+        drain workers, the delayed-delivery pump, per-peer TCP sender
+        lanes) is recorded through a faulty episode including a
+        crash/restart, and the cross-thread acquisition graph must be
+        acyclic — the statistical deadlock signature, caught even on
+        runs where the interleaving never actually deadlocks. Scoped to
+        etcd_tpu-created locks so jax/stdlib internals can't muddy the
+        graph. Reuses the module CFG: no extra compile."""
+        rec = LockOrderRecorder(
+            "chaos-tcp", include=lambda p: "etcd_tpu" in p)
+        rec.enable()  # stays patched through restart: the reborn
+        try:          # member's locks must be recorded too
+            h = make_harness(tmp_path, SEEDS[0], MSG_FAULTS, "tcp")
+            try:
+                h.wait_leaders()
+                h.run_workload(8)
+                h.crash(2)
+                h.restart(2)
+                h.wait_leaders()
+                h.run_workload(4, prefix=b"post")
+            finally:
+                h.stop()
+        finally:
+            rec.disable()
+        assert rec.sites, "recorder saw no etcd_tpu locks — wiring broken"
+        assert rec.edges, "no nested acquisitions recorded"
+        rec.check()
 
 
 class TestLinearizableFailover:

@@ -1,0 +1,261 @@
+"""Runtime-sentinel tests (ISSUE 7): each sentinel must FIRE on a
+seeded violation — installed-but-inert guards are how the r4 artifact
+shipped.
+
+* transfer guard: a deliberate implicit transfer smuggled into the
+  guarded dispatch region is a hard error; the clean round loop runs
+  green under the same guard.
+* recompile sentinel: a deliberate extra static-arg value / novel
+  config trips CompileBudget / the distinct-shape counter.
+* lock-order recorder: a deliberate A->B vs B->A inversion across two
+  threads is reported as a cycle; a clean hierarchy is not.
+
+One tiny config, compiled once for the whole module (~seconds); the
+chaos/hosting lock-order pass over the REAL drain/pump/sender threads
+rides test_chaos.py so it reuses that module's compiled config.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from etcd_tpu.analysis import sentinels
+from etcd_tpu.analysis.lockorder import LockOrderRecorder, LockOrderViolation
+from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
+
+from .conftest import ROUND_STEP_SHAPE_BUDGET
+
+TCFG = BatchedConfig(
+    num_groups=4, num_replicas=3, window=8, max_ents_per_msg=2,
+    max_props_per_round=2, election_timeout=10, heartbeat_timeout=1,
+)
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = MultiRaftEngine(TCFG)
+    e.campaign([g * TCFG.num_replicas for g in range(TCFG.num_groups)])
+    e.run_rounds(4, tick=False)
+    assert (e.leaders() == 0).all()
+    return e
+
+
+# -----------------------------------------------------------------------------
+# Transfer guard
+# -----------------------------------------------------------------------------
+
+
+def test_round_loop_runs_clean_under_guard(eng):
+    """The real engine paths (single round, closed loop, pipelined)
+    are implicit-transfer-free under disallow — the steady-state
+    contract the benches rely on."""
+    assert sentinels.transfer_guard_mode() == "disallow", (
+        "tests/batched/conftest.py must enable the guard for the suite")
+    eng.step_round(tick=True)
+    eng.run_rounds(4, tick=True)
+    eng.run_rounds_pipelined(8, chunk=4, tick=True)
+    assert (eng.leaders() == 0).all()
+
+
+def test_transfer_guard_fires_on_seeded_violation(eng):
+    """Smuggle an eager op (an implicit scalar host->device transfer)
+    into the warm guarded dispatch region: must raise, then the engine
+    must keep working."""
+    eng.run_rounds(4, tick=True)  # ensure rounds=4 program is warm
+    orig = eng._closed_loop
+
+    def poisoned(*a, **kw):
+        jnp.zeros(3)  # eager: implicit transfer inside the guard
+        return orig(*a, **kw)
+
+    eng._closed_loop = poisoned
+    try:
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            eng.run_rounds(4, tick=True)
+    finally:
+        eng._closed_loop = orig
+    eng.run_rounds(4, tick=True)  # guard tripped, engine intact
+
+
+def test_transfer_guard_fires_outside_engine_too():
+    """round_guard() is usable around any dispatch region."""
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with sentinels.round_guard():
+            jnp.asarray([1, 2, 3])
+
+
+def test_cold_compile_is_exempt_then_guarded():
+    """warm_guard: first call (compilation transfers host constants)
+    passes unguarded; the same key is fenced afterwards."""
+    calls = []
+
+    with sentinels.warm_guard("sentinel-test/cold"):
+        calls.append(jnp.asarray([1, 2, 3]))  # "compile": unguarded
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with sentinels.warm_guard("sentinel-test/cold"):
+            jnp.asarray([4, 5, 6])  # warm now: guarded
+    assert len(calls) == 1
+
+
+# -----------------------------------------------------------------------------
+# Recompile sentinel
+# -----------------------------------------------------------------------------
+
+
+def test_compile_budget_fires_on_seeded_extra_static(eng):
+    """A new static `rounds` value recompiles the closed loop; a
+    zero-miss budget must catch exactly that."""
+    eng.run_rounds(4, tick=True)  # warm
+    budget = sentinels.CompileBudget(0).track("closed_loop",
+                                              eng._closed_loop)
+    eng.run_rounds(4, tick=True)
+    assert budget.check() == 0  # steady state: no miss
+    eng.run_rounds(5, tick=True)  # seeded: novel static arg
+    with pytest.raises(sentinels.RecompileBudgetExceeded):
+        budget.check()
+    assert budget.misses() == 1
+
+
+def test_shape_counter_fires_on_seeded_novel_config():
+    """Building the round program for a config nobody else uses must
+    increment the session's distinct-shape count — the signal the
+    conftest budget audits. (Building the program object notes the
+    key; no compile is paid here.)"""
+    from etcd_tpu.batched.step import make_step_round
+
+    before = sentinels.distinct_shapes("round_step")
+    novel = TCFG._replace(window=TCFG.window * 2)  # seeded extra shape
+    make_step_round(novel)
+    after = sentinels.distinct_shapes("round_step")
+    assert after == before + 1, (
+        "the recompile sentinel missed a novel round-step config")
+    make_step_round(novel)  # same config again: cached, no new shape
+    assert sentinels.distinct_shapes("round_step") == after
+
+
+def test_session_usage_within_declared_budget():
+    """Live check of the declared budget (the session fixture enforces
+    it again at teardown, after the whole suite has built its
+    programs)."""
+    used = sentinels.distinct_shapes("round_step")
+    assert 0 < used <= ROUND_STEP_SHAPE_BUDGET, (
+        f"{used} round-step shapes vs budget {ROUND_STEP_SHAPE_BUDGET}; "
+        f"keys:\n" + "\n".join(sorted(sentinels.compile_keys("round_step"))))
+
+
+# -----------------------------------------------------------------------------
+# Lock-order recorder
+# -----------------------------------------------------------------------------
+
+
+def _cycle_pair():
+    """Two locks acquired in opposite nesting order on two threads —
+    the textbook eventual deadlock, interleaved so the test itself
+    never blocks."""
+    with LockOrderRecorder("seeded-cycle") as rec:
+        a = threading.Lock()
+        b = threading.Lock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+    return rec
+
+
+def test_lock_order_cycle_detected():
+    rec = _cycle_pair()
+    cyc = rec.cycles()
+    assert cyc, f"no cycle found; edges: {list(rec.edges)}"
+    with pytest.raises(LockOrderViolation, match="cycle"):
+        rec.check()
+
+
+def test_lock_order_clean_hierarchy_passes():
+    with LockOrderRecorder("clean") as rec:
+        a = threading.Lock()
+        b = threading.Lock()
+
+    def worker():
+        with a:
+            with b:  # same order everywhere: a before b
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.cycles() == []
+    rec.check()  # must not raise
+    assert rec.edges  # and it actually recorded the nesting
+
+
+def test_lock_order_condition_compatible():
+    """threading.Condition built while patched must still work (the
+    chaos pump and hosting read paths use Condition)."""
+    with LockOrderRecorder("cond") as rec:
+        cv = threading.Condition()
+    fired = []
+    entered = threading.Event()
+
+    def waiter():
+        with cv:
+            entered.set()
+            cv.wait(timeout=5)
+            fired.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # `entered` is set while the waiter HOLDS cv, so once the main
+    # thread acquires cv below the waiter is guaranteed parked in
+    # wait() (the only place it releases the lock) — the notify
+    # cannot race ahead of the wait.
+    assert entered.wait(timeout=5)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=5)
+    assert fired == [1]
+    rec.check()
+
+
+def test_lock_order_condition_recursive_hold():
+    """A Condition whose (wrapped) RLock is held RECURSIVELY when
+    wait() runs must still fully release it — Condition probes
+    _release_save/_acquire_restore on the lock, and a proxy hiding
+    them silently degrades wait() to a one-level release: the waiter
+    parks still holding the lock and the notifier deadlocks."""
+    with LockOrderRecorder("cond-recursive") as rec:
+        cv = threading.Condition()
+    fired = []
+    entered = threading.Event()
+
+    def waiter():
+        with cv:
+            with cv:  # depth 2: wait() must release BOTH levels
+                entered.set()
+                cv.wait(timeout=5)
+                fired.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert entered.wait(timeout=5)
+    with cv:  # blocks forever if wait() released only one level
+        cv.notify_all()
+    t.join(timeout=5)
+    assert fired == [1]
+    rec.check()

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# One-shot static gate (ISSUE 7): ruff + jitlint + runtime-sentinel
+# smoke (transfer guard, recompile budget, lock order). CI runs exactly
+# this script (.github/workflows/lint.yml); run it locally before
+# pushing anything that touches the batched hot path.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ruff (ruff.toml: error-class rules over the hot-path scope) =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+elif python -m ruff --version >/dev/null 2>&1; then
+    python -m ruff check .
+else
+    echo "ruff not installed in this environment -- SKIPPED (CI enforces it)"
+fi
+
+echo "== jitlint (trace safety / dtype discipline / purity) =="
+python tools/jitlint.py \
+    etcd_tpu/batched/ etcd_tpu/analysis/ etcd_tpu/tools/ tools/ bench.py
+
+echo "== sentinel smoke (transfer guard, recompile budget, lock order) =="
+python -m pytest tests/analysis tests/batched/test_sentinels.py -q
+
+echo "check.sh: all gates green"

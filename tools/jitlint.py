@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""jitlint CLI: trace-safety / dtype-discipline lint for the batched
+hot path.
+
+    python tools/jitlint.py etcd_tpu/batched/            # the gate
+    python tools/jitlint.py --list-rules
+    python tools/jitlint.py --format json etcd_tpu/batched/step.py
+
+Exit code 0 iff there are zero unwaived findings. Pure AST — no jax,
+no backend, safe anywhere (CI included). Waive a finding with an
+inline comment reading `jitlint: waive(<rule>) -- <reason>`; see
+etcd_tpu/analysis/jitlint.py for the rule catalog and README "Static
+analysis & sentinels".
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from etcd_tpu.analysis import jitlint  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-waived", action="store_true",
+                    help="also print waived findings (audit mode)")
+    args = ap.parse_args()
+
+    if args.list_rules:
+        for rule, doc in sorted(jitlint.RULES.items()):
+            print(f"{rule:20s} {doc}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given (try: tools/jitlint.py etcd_tpu/batched/)")
+
+    try:
+        files = jitlint.collect_files(args.paths)
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+    if not files:
+        print(f"jitlint: no .py files under {args.paths} — refusing to "
+              "pass a vacuous gate", file=sys.stderr)
+        return 2
+    findings = jitlint.lint_paths(files)
+    unwaived = [f for f in findings if not f.waived]
+    waived = [f for f in findings if f.waived]
+
+    if args.format == "json":
+        print(json.dumps([f.__dict__ for f in findings], indent=1))
+    else:
+        for f in unwaived:
+            print(f.format())
+        if args.show_waived:
+            for f in waived:
+                print(f.format())
+        print(f"jitlint: {len(unwaived)} finding(s), "
+              f"{len(waived)} waived", file=sys.stderr)
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
