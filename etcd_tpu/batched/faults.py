@@ -342,12 +342,19 @@ class ChaosHarness:
                  transport: str = "inproc",
                  tick_interval: float = 0.02,
                  pipeline: bool = True,
-                 fence: bool = True) -> None:
+                 fence: bool = True,
+                 trace: bool = False) -> None:
         assert transport in ("inproc", "tcp"), transport
         self.data_dir = data_dir
         self.seed = seed
         self.r = num_members
         self.g = num_groups
+        # trace=True flies the episode with the proposal-lifecycle
+        # tracer on every member (etcd_tpu.obs): the parity/invariant
+        # bar is identical — tracing must be a pure observer even
+        # under faults — and checker failures dump the span rings
+        # alongside the flight recorders.
+        self.trace = bool(trace)
         # fence=False disables the durability watermark + fenced-boot
         # path on every member — the pre-PR behavior, kept so the
         # torn-acked divergence stays demonstrable
@@ -403,7 +410,7 @@ class ChaosHarness:
         m = MultiRaftMember(
             mid, self.r, self.g, self.data_dir, cfg=self.cfg,
             tick_interval=self.tick_interval, pipeline=self.pipeline,
-            fence=self.fence,
+            fence=self.fence, trace=self.trace or None,
         )
         if self.inproc is not None:
             self.inproc.attach(m)
@@ -638,8 +645,9 @@ class ChaosHarness:
         return acked
 
     def dump_flight_recorders(self, reason: str = "chaos") -> List[str]:
-        """Dump every live member's telemetry flight recorder (no-op
-        when the config runs telemetry off); returns the paths."""
+        """Dump every live member's telemetry flight recorder AND
+        trace-span ring (no-ops for whichever plane is off); returns
+        the paths."""
         paths = []
         for m in self.members.values():
             hub = getattr(m, "hub", None)
@@ -649,6 +657,12 @@ class ChaosHarness:
                 except OSError:
                     _log.exception("flight-recorder dump failed (m%d)",
                                    m.id)
+            tracer = getattr(m, "tracer", None)
+            if tracer is not None:
+                try:
+                    paths.append(tracer.dump(reason=reason))
+                except OSError:
+                    _log.exception("trace-ring dump failed (m%d)", m.id)
         return paths
 
     def invariant_trips(self) -> int:

@@ -52,6 +52,7 @@ from ..native.walog import (
     WalogError,
     read_all_classified as wal_read_all_classified,
 )
+from ..obs.tracer import make_tracer
 from ..pkg.failpoint import FailpointPanic, fp
 from ..raft.types import Message, MessageType, Snapshot, SnapshotMetadata
 from .rawnode import BatchedRawNode, BatchedReady, RowRestore
@@ -246,6 +247,7 @@ class MultiRaftMember:
         pipeline: bool = True,
         mesh_devices: int = 0,
         fence: bool = True,
+        trace: Optional[bool] = None,
     ) -> None:
         self.id = member_id
         self.slot = member_id - 1
@@ -339,6 +341,12 @@ class MultiRaftMember:
             self.cfg, groups=groups, slots=slots, restore=restore,
             mesh=mesh,
         )
+        # Proposal-lifecycle tracer (etcd_tpu.obs, ISSUE 9): sampled
+        # spans stamped at every pipeline stage. trace=None defers to
+        # ETCD_TPU_TRACE (off by default); purely host-side, so the
+        # device program and protocol state are identical either way.
+        self.tracer = make_tracer(str(member_id), enabled=trace)
+        self.rn.tracer = self.tracer
         # Telemetry plane (cfg.telemetry): the rawnode folds every
         # round's kernel frame into this hub; WAL fsync latency and
         # per-phase round timings land in the same registry. With
@@ -715,6 +723,13 @@ class MultiRaftMember:
                 self.wal.flush(sync=True)
                 if self._h_fsync is not None:
                     self._h_fsync.observe(time.perf_counter() - tf)
+                if self.tracer is not None:
+                    # One batch fsync covers every appended record, so
+                    # one stamp instant covers every traced key.
+                    tns = time.monotonic_ns()
+                    for rd in batch:
+                        self.tracer.stamp_many(
+                            rd.traced_entries, "fsync", tns)
             # Durable mirrors move only once the records are fsync'd
             # (entries always set must_sync); the commit mirror rides
             # along unsynced — it gates nothing in the fence protocol.
@@ -783,6 +798,13 @@ class MultiRaftMember:
                         data=self.kvs[row].snapshot(),
                     )
                 out.append((row, m))
+        # Apply instant captured here, stamped at the END of this
+        # function: "apply" retires a span, and a same-round
+        # append+commit (solo group) must take its "send" stamp first.
+        tr_apply_ns = (
+            time.monotonic_ns()
+            if self.tracer is not None and rd.traced_commit else 0
+        )
         # 2b. surface ReadIndex progress to waiting readers (after
         #     apply: applied_index moved under the same round).
         if rd.read_opened or rd.read_states or rd.committed:
@@ -798,16 +820,40 @@ class MultiRaftMember:
             self._h_phase["apply"].observe(t1 - t0)
         # 3b. send OUTSIDE the lock: delivery takes the receiver's lock,
         #     and two members sending to each other must not deadlock.
+        # "send" = the instant this round's outbound batch is handed to
+        # the transport — captured BEFORE the hand-off (the wire/peer
+        # clock starts here, not after local serialization returned),
+        # stamped only if something actually left (a round that
+        # persisted a traced entry but transmitted nothing — transport
+        # detached, nothing outbound — must not fabricate a send hop).
+        tr_send_ns = time.monotonic_ns() if self.tracer is not None else 0
+        sent_any = False
         if out and self._send is not None:
             self._send(self.id, out)
+            sent_any = True
         blk = rd.msg_block
         if blk is not None and len(blk):
             if self._send_block is not None:
                 self._send_block(self.id, blk)
+                sent_any = True
             elif self._send is not None:
                 from .msgblock import block_messages
 
                 self._send(self.id, block_messages(blk))
+                sent_any = True
+        if self.tracer is not None:
+            if rd.traced_entries and sent_any:
+                # On the leader the batch carries the entry's MsgApp;
+                # on a follower the same round's block carries its
+                # MsgAppResp — either way, the ack/replication clock
+                # starts here.
+                self.tracer.stamp_many(rd.traced_entries, "send",
+                                       tr_send_ns)
+            if rd.traced_commit:
+                # Terminal stamp (retires the span) at the instant the
+                # apply loop finished above.
+                self.tracer.stamp_many(rd.traced_commit, "apply",
+                                       tr_apply_ns)
         dt = time.perf_counter() - t1
         self.stats["send_s"] += dt
         if self._h_phase is not None:
@@ -1682,14 +1728,15 @@ class MultiRaftCluster:
                  cfg: Optional[BatchedConfig] = None,
                  pipeline: bool = True,
                  mesh_devices: int = 0,
-                 fence: bool = True) -> None:
+                 fence: bool = True,
+                 trace: Optional[bool] = None) -> None:
         self.router = InProcRouter()
         self.members: Dict[int, MultiRaftMember] = {}
         for mid in range(1, num_members + 1):
             m = MultiRaftMember(
                 mid, num_members, num_groups, data_dir, cfg=cfg,
                 pipeline=pipeline, mesh_devices=mesh_devices,
-                fence=fence,
+                fence=fence, trace=trace,
             )
             self.router.attach(m)
             self.members[mid] = m

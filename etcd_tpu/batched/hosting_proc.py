@@ -172,6 +172,19 @@ class AdminServer:
             from ..pkg import metrics as pmet
 
             return {"ok": True, "text": pmet.DEFAULT.expose()}
+        if op == "trace":
+            # Proposal-lifecycle trace ring (etcd_tpu.obs): inline
+            # payload by default (tools/trace_merge.py joins the
+            # members' payloads), or a JSON dump next to the flight
+            # recorders with {"dump": true}.
+            if m.tracer is None:
+                return {"err": "tracing disabled (start the member "
+                               "with --trace / ETCD_TPU_TRACE=1)"}
+            if req.get("dump"):
+                path = m.tracer.dump(reason=req.get("reason", "admin"))
+                return {"ok": True, "path": path,
+                        "spans": m.tracer.span_count()}
+            return {"ok": True, "payload": m.tracer.to_payload()}
         if op == "flightrec":
             # Dump the member's flight recorder (last K rounds of
             # per-group telemetry deltas) to a JSON file on demand.
@@ -315,7 +328,8 @@ def serve(member_id: int, num_members: int, num_groups: int,
           peers: Dict[int, Tuple[str, int]],
           window: int = 32,
           tick_interval: float = 0.1,
-          telemetry: bool = False) -> None:
+          telemetry: bool = False,
+          trace: Optional[bool] = None) -> None:
     from .hosting import MultiRaftMember
     from .state import BatchedConfig
 
@@ -336,7 +350,7 @@ def serve(member_id: int, num_members: int, num_groups: int,
     )
     member = MultiRaftMember(
         member_id, num_members, num_groups, data_dir, cfg=cfg,
-        tick_interval=tick_interval,
+        tick_interval=tick_interval, trace=trace,
     )
     from .hosting import TCPRouter
 
@@ -365,6 +379,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--telemetry", action="store_true",
                    help="enable the kernel telemetry plane (metrics + "
                         "flight recorder via the admin API)")
+    p.add_argument("--trace", action="store_true",
+                   help="enable proposal-lifecycle tracing (sampled "
+                        "span stamps; admin 'trace' op serves the "
+                        "ring — see ETCD_TPU_TRACE_SAMPLE/_SEED)")
     a = p.parse_args(argv)
 
     def hp(s: str) -> Tuple[str, int]:
@@ -377,7 +395,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         peers[int(pid)] = hp(addr)
     serve(a.id, a.members, a.groups, a.data_dir, hp(a.bind),
           hp(a.admin), peers, window=a.window,
-          tick_interval=a.tick_interval, telemetry=a.telemetry)
+          tick_interval=a.tick_interval, telemetry=a.telemetry,
+          trace=a.trace or None)
 
 
 # -- client side ---------------------------------------------------------------

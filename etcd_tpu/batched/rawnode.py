@@ -173,6 +173,12 @@ class BatchedReady:
     # Quorum-confirmed ReadIndex batches this round: (row, seq, index)
     # (ref: Ready.ReadStates, read_only.go advance).
     read_states: List[Tuple[int, int, int]] = field(default_factory=list)
+    # Sampled trace keys (etcd_tpu.obs): (group, term, index) of traced
+    # entries persisted this round (the hosting layer stamps fsync/send
+    # on them) and of traced entries newly committed this round (apply
+    # stamp). Empty lists when tracing is off — zero per-round cost.
+    traced_entries: List[Tuple[int, int, int]] = field(default_factory=list)
+    traced_commit: List[Tuple[int, int, int]] = field(default_factory=list)
     # Batches that OPENED this round: (row, seq). Hosts bind waiters to
     # the open batch so a later waiter is never served an earlier
     # batch's (stale) index.
@@ -348,6 +354,11 @@ class BatchedRawNode:
         # folds it into the attached hub (hosting layer sets one).
         self.telemetry_hub = None  # TelemetryHub, optional
         self.last_frame: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        # Proposal-lifecycle tracer (etcd_tpu.obs.Tracer, optional —
+        # hosting layer attaches one). Purely host-side: the device
+        # program and protocol state are identical with it on or off;
+        # the hot path pays one `is not None` per round when off.
+        self.tracer = None
 
     # -- boot ------------------------------------------------------------------
 
@@ -435,8 +446,12 @@ class BatchedRawNode:
         (conf changes) — the tag rides the host arena, never the
         device. Callers that need follower forwarding do it above this
         layer (see batched/node.py)."""
+        # Enqueue timestamp rides the queue tuple only when tracing is
+        # on (the span's propose stamp — sampling is decided later, at
+        # index-assignment time, because the index IS the sample key).
+        t_enq = 0 if self.tracer is None else time.monotonic_ns()
         with self._lock:
-            self._props[row].append((data, int(etype)))
+            self._props[row].append((data, int(etype), t_enq))
 
     def set_membership(self, row: int, voters, voters_out=(),
                        learners=(), joint: bool = False) -> None:
@@ -582,6 +597,11 @@ class BatchedRawNode:
         cfg = self.cfg
         r, e, w = cfg.num_replicas, cfg.max_ents_per_msg, cfg.window
         prof = self.prof
+        tracer = self.tracer
+        # Trace stamps use monotonic_ns (the tracer's clock domain, NOT
+        # perf_counter): stage = staging begins, dispatch = device
+        # round dispatched, extract = device done / host extraction.
+        tr_stage = time.monotonic_ns() if tracer is not None else 0
         t0 = time.perf_counter()
 
         self._lock.acquire()
@@ -668,6 +688,7 @@ class BatchedRawNode:
                 send_append=st0.send_append.at[jnp.asarray(poke_rows)]
                 .set(True)
             )
+        tr_dispatch = time.monotonic_ns() if tracer is not None else 0
         # Host->device staging happens OUTSIDE the transfer guard (it
         # is the intended, bulk transfer of the round); the guarded
         # region below is then pure warm device dispatch, where any
@@ -723,6 +744,7 @@ class BatchedRawNode:
                     tel_counters, tel_inv,
                     extra={"outbox_lanes": lane_summary(
                         np.asarray(outbox.valid))})
+        tr_extract = time.monotonic_ns() if tracer is not None else 0
         t1 = time.perf_counter()
         self.phase_last["step"] = t1 - t0
         if prof is not None:
@@ -751,15 +773,22 @@ class BatchedRawNode:
                 n_app = int(last[row] - last_tick[row])
                 base = int(last_tick[row])
                 t_row = int(term[row])
+                g_row = int(self.groups[row])
                 ar = self.arena[row]
                 ets = self.etypes[row]
                 for j in range(n_app):
-                    data, et = q.popleft()
+                    data, et, t_enq = q.popleft()
                     idx = base + 1 + j
                     ar[idx] = (t_row, data)
                     ets.pop(idx, None)
                     if et:
                         ets[idx] = et
+                    if (tracer is not None and t_enq
+                            and tracer.sampled(g_row, idx)):
+                        # The origin stamp: index just got assigned, so
+                        # the sampling decision exists only now; the
+                        # stamp's time is the client enqueue instant.
+                        tracer.stamp(g_row, t_row, idx, "propose", t_enq)
 
             # -- entry records to persist: per row the contiguous range
             # (lo-1, last] where lo is the first ring-changed index
@@ -817,6 +846,26 @@ class BatchedRawNode:
                         eb_rows, eb_idx, eb_term,
                         np.asarray(etys, np.int64), datas)
 
+            # Sampled trace keys among this round's persisted entries
+            # (leader appends and follower appends alike — both sides'
+            # fragments come from the same extraction path): stamp the
+            # round phases, hand the keys to the hosting layer for the
+            # fsync/send stamps.
+            traced_entries: List[Tuple[int, int, int]] = []
+            if tracer is not None and len(entries):
+                hits = np.nonzero(tracer.sampled_arr(
+                    self.groups[entries.rows], entries.idx))[0]
+                if len(hits):
+                    traced_entries = list(zip(
+                        self.groups[entries.rows[hits]].tolist(),
+                        entries.term[hits].tolist(),
+                        entries.idx[hits].tolist()))
+                    tracer.stamp_many(traced_entries, "stage", tr_stage)
+                    tracer.stamp_many(traced_entries, "dispatch",
+                                      tr_dispatch)
+                    tracer.stamp_many(traced_entries, "extract",
+                                      tr_extract)
+
             # -- hardstate deltas
             hardstates = [
                 (int(row), int(term[row]), int(vote[row]), int(commit[row]))
@@ -830,6 +879,7 @@ class BatchedRawNode:
             committed: List[
                 Tuple[int, List[Tuple[int, int, Optional[bytes]]]]
             ] = []
+            traced_commit: List[Tuple[int, int, int]] = []
             com_rows = np.nonzero(commit > self.applied)[0]
             if len(com_rows):
                 loc = np.maximum(self.applied[com_rows], snap64[com_rows])
@@ -859,6 +909,17 @@ class BatchedRawNode:
                         ))
                     pos = end
                     committed.append((row, items))
+                if tracer is not None and len(c_idx):
+                    hits = np.nonzero(tracer.sampled_arr(
+                        self.groups[c_rows], c_idx))[0]
+                    if len(hits):
+                        traced_commit = list(zip(
+                            self.groups[c_rows[hits]].tolist(),
+                            c_term[hits].tolist(),
+                            c_idx[hits].tolist()))
+                        # Commit became observable at extraction time.
+                        tracer.stamp_many(traced_commit, "commit",
+                                          tr_extract)
 
             t1 = time.perf_counter()
             self.phase_last["extract"] = t1 - t0
@@ -921,6 +982,8 @@ class BatchedRawNode:
             read_states=read_states,
             read_opened=read_opened,
             snap_rings=snap_rings,
+            traced_entries=traced_entries,
+            traced_commit=traced_commit,
         )
 
     def advance(self) -> None:

@@ -1137,6 +1137,20 @@ def _emit(cfg: BatchedConfig, slot, st: BatchedState):
     return st, out
 
 
+# Annotation registry for tools/phaseprobe.py and trace tooling: the
+# named_scope segments of one round, in execution order. Labels match
+# the jax.named_scope strings below exactly, so xprof captures, the
+# phaseprobe artifact, and the SURVEY/ROADMAP prose all name the same
+# segments.
+ROUND_PHASE_SCOPES = (
+    ("deliver", "raft_deliver"),
+    ("tick", "raft_tick"),
+    ("control", "raft_control"),
+    ("propose", "raft_propose"),
+    ("emit", "raft_emit"),
+    ("route", "raft_route"),
+)
+
 # -----------------------------------------------------------------------------
 # Round assembly + router
 # -----------------------------------------------------------------------------

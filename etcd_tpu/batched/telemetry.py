@@ -157,6 +157,33 @@ def fenced_groups_gauge(
     ))
 
 
+def trace_span_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    """Spans opened by the proposal-lifecycle tracer (etcd_tpu.obs) —
+    the sampled 1-in-N population size, so rates can be scaled back to
+    absolute proposal counts."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_trace_spans_total",
+        "proposal-lifecycle trace spans opened (sampled)",
+        ("member",),
+    ))
+
+
+def trace_drop_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    """Tracer shedding classes (open_evict: span evicted before apply;
+    ring_evict: retired span pushed out of the bounded ring). The
+    tracer never sheds silently — a hot run that overflows its rings
+    shows up here, not as a mystery gap in the merged timeline."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_trace_span_drops_total",
+        "proposal-lifecycle trace spans dropped/evicted, by class",
+        ("member", "cls"),
+    ))
+
+
 def router_loss_counter(
         registry: Optional[pmet.Registry] = None) -> pmet.Counter:
     """One source of truth for transport drop classes (InProcRouter and

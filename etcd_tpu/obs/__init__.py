@@ -1,0 +1,26 @@
+"""Sampled proposal-lifecycle tracing for the batched hosting path.
+
+PR 4's telemetry plane answers *what happened* (counters, invariant
+sweep, flight recorder); this package answers *where the time went*:
+deterministically sampled proposals are stamped with monotonic clocks
+at every pipeline stage — propose-enqueue, round staging, device
+dispatch, Ready extraction, WAL fsync, outbound send, commit, apply —
+on every member that touches them, keyed by ``(group, term, index)`` so
+peer-side spans need no wire-format change (Dapper's causal join trick:
+the identifiers already on the wire ARE the trace id).
+
+Pieces:
+
+* ``tracer.Tracer`` — lock-cheap per-member span collector with a
+  bounded ring (drops are counted on pkg.metrics, never silent).
+* ``export`` — Chrome-trace / Perfetto JSON exporter + validator.
+* ``tools/trace_merge.py`` — joins per-member dumps into one timeline
+  with cross-process clock-offset estimation from send/recv pairs.
+
+Tracing is OFF by default and purely host-side: the jitted round
+program and protocol state are bit-identical with it on or off
+(tests/obs/test_tracing.py pins both).
+"""
+
+from .tracer import STAGES, Tracer, make_tracer  # noqa: F401
+from .export import chrome_trace, validate_chrome_trace  # noqa: F401

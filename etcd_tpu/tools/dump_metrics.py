@@ -5,7 +5,14 @@ Three sources: an HTTP /metrics endpoint (--addr), a batched hosting
 member's admin port (--admin, the line-JSON 'metrics' op serving the
 same Prometheus text — kernel telemetry counters, invariant trips,
 WAL fsync / round-phase histograms, router loss classes), or the local
-registry (default: every metric this build registers)."""
+registry (default: every metric this build registers).
+
+``--watch N`` re-scrapes every N seconds and prints per-interval
+deltas and rates for every series that moved — eyeball a live hosted
+run without restarting the scrape loop by hand::
+
+    python -m etcd_tpu.tools.dump_metrics --admin 127.0.0.1:8001 --watch 5
+"""
 
 from __future__ import annotations
 
@@ -13,8 +20,9 @@ import argparse
 import json
 import socket
 import sys
+import time
 import urllib.request
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 def _print_text(text: str, names_only: bool) -> int:
@@ -25,13 +33,12 @@ def _print_text(text: str, names_only: bool) -> int:
     return 0
 
 
-def dump_url(url: str, names_only: bool = False) -> int:
+def _fetch_url(url: str) -> str:
     with urllib.request.urlopen(url, timeout=10) as r:
-        text = r.read().decode()
-    return _print_text(text, names_only)
+        return r.read().decode()
 
 
-def dump_admin(addr: str, names_only: bool = False) -> int:
+def _fetch_admin(addr: str) -> str:
     """Scrape a hosting member's admin endpoint (hosting_proc
     AdminServer, op 'metrics')."""
     host, _, port = addr.rpartition(":")
@@ -41,9 +48,78 @@ def dump_admin(addr: str, names_only: bool = False) -> int:
         f.flush()
         resp = json.loads(f.readline())
     if not resp.get("ok"):
-        print(f"admin metrics failed: {resp}", file=sys.stderr)
+        raise RuntimeError(f"admin metrics failed: {resp}")
+    return resp["text"]
+
+
+def parse_series(text: str) -> Dict[str, float]:
+    """Prometheus exposition text -> {series-with-labels: value}."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def watch(fetch: Callable[[], str], interval: float,
+          count: int = 0) -> int:
+    """Periodic re-scrape: after the baseline snapshot, print every
+    series whose value moved, with the interval delta and per-second
+    rate. Runs until `count` intervals (0 = forever / Ctrl-C)."""
+    prev = parse_series(fetch())
+    t_prev = time.monotonic()
+    print(f"baseline: {len(prev)} series; interval {interval:g}s",
+          flush=True)
+    i = 0
+    while count == 0 or i < count:
+        time.sleep(interval)
+        try:
+            cur = parse_series(fetch())
+        except (OSError, RuntimeError, ConnectionError) as e:
+            # Transient by design: this codebase's own flows kill -9
+            # and restart members mid-run. Keep the baseline and keep
+            # scraping — the whole point of --watch is not having to
+            # restart the loop by hand.
+            print(f"scrape failed (retrying next interval): {e}",
+                  file=sys.stderr, flush=True)
+            i += 1
+            continue
+        now = time.monotonic()
+        dt = max(now - t_prev, 1e-9)
+        stamp = time.strftime("%H:%M:%S")
+        moved = []
+        for name, v in sorted(cur.items()):
+            d = v - prev.get(name, 0.0)
+            if d == 0 and name in prev:
+                continue
+            moved.append((name, v, d))
+        print(f"-- {stamp} (+{dt:.1f}s, {len(moved)} series moved)",
+              flush=True)
+        for name, v, d in moved:
+            print(f"{name} {v:g}  Δ{d:+g}  ({d / dt:+.1f}/s)",
+                  flush=True)
+        prev, t_prev = cur, now
+        i += 1
+    return 0
+
+
+def dump_url(url: str, names_only: bool = False) -> int:
+    return _print_text(_fetch_url(url), names_only)
+
+
+def dump_admin(addr: str, names_only: bool = False) -> int:
+    try:
+        text = _fetch_admin(addr)
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
         return 1
-    return _print_text(resp["text"], names_only)
+    return _print_text(text, names_only)
 
 
 def dump_local(names_only: bool = False) -> int:
@@ -80,15 +156,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="scrape a batched hosting member's admin port "
                         "(host:port, hosting_proc 'metrics' op)")
     p.add_argument("--names-only", action="store_true")
+    p.add_argument("--watch", type=float, default=0.0, metavar="N",
+                   help="re-scrape every N seconds, printing deltas/"
+                        "rates per interval for series that moved")
+    p.add_argument("--count", type=int, default=0,
+                   help="stop --watch after this many intervals "
+                        "(0 = run until interrupted)")
     args = p.parse_args(argv)
-    if args.admin:
-        return dump_admin(args.admin, args.names_only)
-    if args.addr:
-        url = args.addr
+    url = args.addr
+    if url:
         if not url.startswith("http"):
             url = f"http://{url}"
         if not url.endswith("/metrics"):
             url += "/metrics"
+    if args.watch > 0:
+        if args.admin:
+            return watch(lambda: _fetch_admin(args.admin), args.watch,
+                         args.count)
+        if url:
+            return watch(lambda: _fetch_url(url), args.watch,
+                         args.count)
+        print("--watch needs --admin or --addr (the local registry "
+              "has nothing moving)", file=sys.stderr)
+        return 2
+    if args.admin:
+        return dump_admin(args.admin, args.names_only)
+    if url:
         return dump_url(url, args.names_only)
     return dump_local(args.names_only)
 

@@ -15,6 +15,15 @@ Writes HOSTED_BENCH.json at the repo root:
 the BENCH_NOTES phase table, reproducible from the artifact; the same
 split is exported as the round-phase histograms under --telemetry.)
 
+With ``--trace`` the workers run the proposal-lifecycle tracer
+(etcd_tpu.obs) and the artifact additionally carries ``slo``: per-hop
+p50/p99 over the merged cross-member spans (the named decomposition
+propose→stage→step→fsync→send→peer-fsync→ack→commit→apply) plus
+traced commit/apply percentiles — the per-hop budget shape ROADMAP
+item 4's gRPC SLO story consumes. The merged Perfetto trace lands in
+``artifacts/hosted_trace.json``. Tracing has measurable sampling cost,
+so ``--trace`` runs are labeled and are NOT the parity baseline.
+
 Run:  python -m etcd_tpu.tools.hosted_bench [--groups 1024] [--n 3000]
 """
 
@@ -43,7 +52,8 @@ def free_ports(n):
     return ports
 
 
-def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0):
+def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0,
+          trace=0):
     peers = [
         f"--peer={pid}=127.0.0.1:{raft_ports[pid]}"
         for pid in range(1, MEMBERS + 1) if pid != mid
@@ -51,6 +61,12 @@ def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["ETCD_TPU_PROF"] = "1"
+    if trace:
+        # Sample rate shared by all members (the cross-member join
+        # requires identical sampling decisions); seed pinned so two
+        # --trace runs sample the same key population.
+        env["ETCD_TPU_TRACE_SAMPLE"] = str(trace)
+        env.setdefault("ETCD_TPU_TRACE_SEED", "0")
     # Transfer sentinel (ISSUE 7): worker round dispatch fails hard on
     # any implicit transfer instead of silently syncing per round.
     env.setdefault("ETCD_TPU_TRANSFER_GUARD", "disallow")
@@ -67,7 +83,7 @@ def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0):
             "--bind", f"127.0.0.1:{raft_ports[mid]}",
             "--admin", f"127.0.0.1:{admin_ports[mid]}",
             "--tick-interval", "0.1",
-        ] + peers,
+        ] + (["--trace"] if trace else []) + peers,
         env=env, stdout=log, stderr=subprocess.STDOUT,
     )
 
@@ -83,6 +99,11 @@ def main() -> None:
                     help="wave cap per led group")
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--trace", type=int, nargs="?", const=8, default=0,
+                    metavar="SAMPLE",
+                    help="run the workers with proposal-lifecycle "
+                         "tracing (1-in-SAMPLE, default 8) and record "
+                         "the per-hop SLO table into the artifact")
     args = ap.parse_args()
     import tempfile
 
@@ -97,7 +118,7 @@ def main() -> None:
     try:
         for mid in range(1, MEMBERS + 1):
             procs[mid] = spawn(mid, raft_p, admin_p, data_dir,
-                               args.groups)
+                               args.groups, trace=args.trace)
         for mid in range(1, MEMBERS + 1):
             clients[mid] = wait_admin(("127.0.0.1", admin_p[mid]),
                                       timeout=300.0)
@@ -207,6 +228,46 @@ def main() -> None:
             "per_member": parts,
         }
 
+        # SLO table (--trace): pull every member's span ring over the
+        # admin 'trace' op and join them in-process — per-hop p50/p99
+        # on the aligned clock, the shape the gRPC front-end's SLO
+        # story consumes. Captured BEFORE the kill below tears member
+        # 3's ring away.
+        slo = None
+        if args.trace:
+            from etcd_tpu.obs.export import validate_chrome_trace
+            from etcd_tpu.obs.merge import hop_stats, merge
+
+            payloads = []
+            for mid, c in clients.items():
+                r = c.call(op="trace")
+                if r.get("ok"):
+                    payloads.append(r["payload"])
+                else:
+                    print(f"member {mid} trace pull failed: {r}",
+                          file=sys.stderr)
+            if len(payloads) == MEMBERS:
+                trace_obj, slo = merge(payloads)
+                validate_chrome_trace(trace_obj)
+                tpath = os.path.join(repo, "artifacts",
+                                     "hosted_trace.json")
+                os.makedirs(os.path.dirname(tpath), exist_ok=True)
+                with open(tpath, "w") as f:
+                    json.dump(trace_obj, f)
+                    f.write("\n")
+                slo["merged_trace"] = os.path.relpath(tpath, repo)
+                # Self-labeling: the slo block names its own capture
+                # conditions, so grafting it into an untraced headline
+                # artifact (traced runs are never the headline — the
+                # sampling cost is real) keeps the provenance visible.
+                slo["config"] = (f"G={args.groups} R={MEMBERS} "
+                                 f"value={args.value_size}B "
+                                 f"inflight={args.inflight}/group CPU "
+                                 f"trace=1/{args.trace}")
+                slo["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+                print(f"slo: {json.dumps(slo['hops'])}",
+                      file=sys.stderr)
+
         # Restart catch-up: kill -9 member 3, write under its nose,
         # restart, time until it serves the missed write.
         procs[3].kill()
@@ -217,7 +278,7 @@ def main() -> None:
                         v="MQ==")
         t0 = time.monotonic()
         procs[3] = spawn(3, raft_p, admin_p, data_dir, args.groups,
-                         gen=1)
+                         gen=1, trace=args.trace)
         clients[3] = wait_admin(("127.0.0.1", admin_p[3]), timeout=300.0)
         while time.monotonic() - t0 < 180.0:
             if clients[3].get(g, b"catchup") == b"1":
@@ -239,9 +300,13 @@ def main() -> None:
             "restart_catchup_s": round(catchup_s, 1),
             "config": (f"G={args.groups} R={MEMBERS} procs={MEMBERS} "
                        f"value={args.value_size}B "
-                       f"inflight={args.inflight}/group CPU"),
+                       f"inflight={args.inflight}/group CPU"
+                       + (f" trace=1/{args.trace}" if args.trace
+                          else "")),
             "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }
+        if slo is not None:
+            result["slo"] = slo
         with open(out_path, "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")

@@ -65,7 +65,13 @@ class TestChaosMatrix:
         mid-workload."""
         h = ChaosHarness(str(tmp_path), seed, SOAK_FAULTS,
                          num_members=R, num_groups=G, cfg=CFG,
-                         transport=transport)
+                         transport=transport,
+                         # Tracing on under the heaviest fault class
+                         # (ISSUE 9): the tracer must stay a pure
+                         # observer — same strict three-checker close,
+                         # same zero-invariant-trip bar as untraced
+                         # episodes, with telemetry watching.
+                         trace=True)
         obs = LeaderObserver(h.alive)
         try:
             h.wait_leaders()

@@ -35,13 +35,15 @@ def free_ports(n):
     return ports
 
 
-def spawn(mid, raft_ports, admin_ports, data_dir, gen=0):
+def spawn(mid, raft_ports, admin_ports, data_dir, gen=0, trace=False):
     peers = [
         f"--peer={pid}=127.0.0.1:{raft_ports[pid]}"
         for pid in range(1, MEMBERS + 1) if pid != mid
     ]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    if trace:
+        env["ETCD_TPU_TRACE_SAMPLE"] = "1"  # trace every proposal
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PYTHONPATH"] = (
         os.path.dirname(os.path.dirname(os.path.dirname(
@@ -59,7 +61,7 @@ def spawn(mid, raft_ports, admin_ports, data_dir, gen=0):
             "--bind", f"127.0.0.1:{raft_ports[mid]}",
             "--admin", f"127.0.0.1:{admin_ports[mid]}",
             "--tick-interval", "0.1",
-        ] + peers,
+        ] + (["--trace"] if trace else []) + peers,
         env=env,
         stdout=log,
         stderr=subprocess.STDOUT,
@@ -147,8 +149,12 @@ def test_three_process_cluster_kill9_restart(tmp_path):
     procs = {}
     clients = {}
     try:
+        # Tracing on (ISSUE 9): this test doubles as the e2e exercise
+        # of the proposal-lifecycle tracer across real processes, a
+        # kill -9, and a restart.
         for mid in range(1, MEMBERS + 1):
-            procs[mid] = spawn(mid, raft_p, admin_p, str(tmp_path))
+            procs[mid] = spawn(mid, raft_p, admin_p, str(tmp_path),
+                               trace=True)
         for mid in range(1, MEMBERS + 1):
             clients[mid] = wait_admin(("127.0.0.1", admin_p[mid]),
                                       timeout=180.0)
@@ -172,6 +178,23 @@ def test_three_process_cluster_kill9_restart(tmp_path):
               f"{bench['p50_ms']}ms p99 {bench['p99_ms']}ms")
         assert bench["puts_per_sec"] > 0
 
+        # Admin 'trace' op (ISSUE 9): every member serves its span
+        # ring inline; the cross-process merge joins them and the
+        # export validates — real processes, real clock domains.
+        from etcd_tpu.obs.export import validate_chrome_trace
+        from etcd_tpu.obs.merge import merge as trace_merge
+
+        payloads = []
+        for mid, c in clients.items():
+            tr = c.call(op="trace")
+            assert tr.get("ok"), tr
+            assert tr["payload"]["member"] == str(mid)
+            payloads.append(tr["payload"])
+        trace_obj, tstats = trace_merge(payloads)
+        validate_chrome_trace(trace_obj)
+        assert tstats["spans_origin"] > 0, tstats
+        assert tstats["spans_peer_decomposed"] > 0, tstats
+
         # kill -9 member 3: quorum survives, its groups re-elect.
         procs[3].kill()
         procs[3].wait(timeout=10)
@@ -185,7 +208,8 @@ def test_three_process_cluster_kill9_restart(tmp_path):
 
         # Restart member 3 from the same data dir: WAL replay +
         # snapshot/append catch-up at the hosting layer.
-        procs[3] = spawn(3, raft_p, admin_p, str(tmp_path), gen=1)
+        procs[3] = spawn(3, raft_p, admin_p, str(tmp_path), gen=1,
+                         trace=True)
         clients[3] = wait_admin(("127.0.0.1", admin_p[3]), timeout=180.0)
 
         # Durability-fence visibility (ISSUE 5): the health op reports

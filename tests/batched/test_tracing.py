@@ -1,0 +1,221 @@
+"""Proposal-lifecycle tracing (ISSUE 9): tracing must be a pure
+observer. Deterministic 3-member bit-parity (tracing on vs off over an
+identical synchronous schedule), zero compile-shape growth, full
+propose→apply span assembly, cross-member merge on real spans, and a
+traced chaos episode closing at strict parity.
+
+Config is value-identical to tests/batched/test_chaos.py's CFG
+(member-style rawnodes: G rows, one slot per group), so the whole
+module reuses the chaos subset's compiled round program — no tier-1
+compile budget spent.
+"""
+
+import numpy as np
+import pytest
+
+from etcd_tpu.batched.faults import (
+    ChaosHarness,
+    FaultSpec,
+    LeaderObserver,
+    run_invariant_checks,
+)
+from etcd_tpu.batched.rawnode import BatchedRawNode
+from etcd_tpu.obs.export import validate_chrome_trace
+from etcd_tpu.obs.merge import merge
+from etcd_tpu.obs.tracer import STAGES, Tracer
+from etcd_tpu.pkg import failpoint
+from etcd_tpu.pkg import metrics as pmet
+
+from .test_chaos import CFG, G, R
+
+MEMBERS = (1, 2, 3)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def build(trace_on):
+    """Three member-style rawnodes (the hosting shape: G rows, member
+    mid holding slot mid-1 of every group), tracer attached exactly as
+    MultiRaftMember does — before any proposal is staged."""
+    rns = {}
+    for mid in MEMBERS:
+        rn = BatchedRawNode(
+            CFG,
+            groups=np.arange(G, dtype=np.int32),
+            slots=np.full(G, mid - 1, np.int32),
+        )
+        if trace_on:
+            rn.tracer = Tracer(member=str(mid), sample=1, seed=0,
+                               registry=pmet.Registry())
+        rns[mid] = rn
+    return rns
+
+
+def digest(rd):
+    """Everything protocol-visible in one Ready, hashable."""
+    return (
+        tuple(rd.hardstates),
+        tuple(iter(rd.entries)),
+        tuple((row, tuple(items)) for row, items in rd.committed),
+        tuple((row, int(m.type), m.to, m.from_, m.index, m.term,
+               m.commit, m.reject)
+              for row, m in rd.messages),
+        None if rd.msg_block is None else rd.msg_block.to_bytes(),
+        tuple(rd.read_states),
+        rd.must_sync,
+    )
+
+
+def pump(rns, rounds):
+    """Synchronous deterministic router: each member advances one
+    round, its outbound block/messages delivered immediately, the
+    hosting-side trace stamps (fsync/send/apply) taken where hosting
+    takes them. Single-threaded — identical schedules bit-reproduce."""
+    digs = []
+    for _ in range(rounds):
+        for mid in MEMBERS:
+            rn = rns[mid]
+            rd = rn.advance_round()
+            blk = rd.msg_block
+            if blk is not None and len(blk):
+                for to, sub in sorted(blk.split_by_target().items()):
+                    rns[to].step_block(sub)
+            for row, m in rd.messages:
+                rns[m.to].step(row, m)
+            tr = rn.tracer
+            if tr is not None:
+                tr.stamp_many(rd.traced_entries, "fsync")
+                tr.stamp_many(rd.traced_entries, "send")
+                tr.stamp_many(rd.traced_commit, "apply")
+            rn.advance()
+            digs.append(digest(rd))
+    return digs
+
+
+def drive(rns):
+    """One fixed schedule: balanced elections, one proposal per group,
+    enough rounds for append→ack→commit→apply on every group."""
+    digs = []
+    for mid, rn in rns.items():
+        rn.campaign([g for g in range(G) if g % R == mid - 1])
+    digs += pump(rns, 6)
+    for mid, rn in rns.items():
+        for g in range(G):
+            if g % R == mid - 1:
+                rn.propose(g, b"payload-%d" % g)
+    digs += pump(rns, 8)
+    return digs
+
+
+class TestBitParity:
+    def test_tracing_off_on_bit_identical_and_no_new_programs(self):
+        """Acceptance: tracing on must not change one bit of protocol
+        state or Ready content vs tracing off, and must not compile
+        any new round-step program (the jitted round is untouched)."""
+        from etcd_tpu.analysis import sentinels
+
+        off = build(False)
+        d_off = drive(off)
+        shapes_before = sentinels.distinct_shapes("round_step")
+
+        on = build(True)
+        d_on = drive(on)
+        assert sentinels.distinct_shapes("round_step") == shapes_before, (
+            "tracing=on compiled a new round-step program")
+
+        assert d_off == d_on, "Ready stream diverged with tracing on"
+        for mid in MEMBERS:
+            a, b = off[mid], on[mid]
+            for f in a.state._fields:
+                av, bv = np.asarray(getattr(a.state, f)), np.asarray(
+                    getattr(b.state, f))
+                assert np.array_equal(av, bv), (
+                    f"member {mid} state.{f} diverged with tracing on")
+
+        # The traced run really traced: every group's proposal closed
+        # a complete span on its origin member with every stage.
+        # (Election no-op entries also complete spans, but carry no
+        # propose stamp — there was no client enqueue — so select the
+        # proposal spans by their origin stamp.)
+        complete = {}
+        for mid in MEMBERS:
+            for sp in on[mid].tracer.spans(include_open=False):
+                if sp["complete"] and "propose" in sp["stages"]:
+                    complete.setdefault(sp["group"], sp)
+        assert len(complete) == G, (
+            f"expected a completed span per group, got "
+            f"{sorted(complete)}")
+        for g, sp in complete.items():
+            assert set(sp["stages"]) == set(STAGES), (
+                f"group {g} span missing stages "
+                f"{set(STAGES) - set(sp['stages'])}")
+            # Stamps are causally ordered within the member clock.
+            ts = [sp["stages"][s] for s in STAGES]
+            assert ts == sorted(ts)
+
+    def test_merge_on_real_spans(self):
+        """The cross-member join works on spans the real round
+        produced: every proposal decomposes against a peer fragment
+        and the export is Perfetto-loadable."""
+        rns = build(True)
+        drive(rns)
+        payloads = [rns[mid].tracer.to_payload() for mid in MEMBERS]
+        trace, stats = merge(payloads)
+        validate_chrome_trace(trace)
+        assert stats["spans_origin"] == G
+        assert stats["spans_peer_decomposed"] == G
+        # Single-process members share one clock: estimated offsets
+        # must be tiny (well under a round).
+        assert all(abs(v) < 50_000_000
+                   for v in stats["clock_offsets_ns"].values())
+
+    def test_sampling_off_keys_stamps_nothing(self):
+        """sample=N only stamps the deterministic 1-in-N population —
+        unsampled proposals cost nothing and leave no span."""
+        rns = build(True)
+        for rn in rns.values():
+            rn.tracer.sample = 2**30  # sample ~nothing
+        drive(rns)
+        for mid in MEMBERS:
+            assert rns[mid].tracer.span_count() == 0
+
+
+class TestChaosTraceParity:
+    def test_traced_chaos_episode_strict_parity(self, tmp_path,
+                                                monkeypatch):
+        """A lossy-link chaos episode flown with tracing on must close
+        at the same strict bar as untraced episodes — all three
+        checkers, zero invariant trips — and the harness's failure
+        path must be able to dump every member's span ring."""
+        monkeypatch.setenv("ETCD_TPU_TRACE_SAMPLE", "1")
+        monkeypatch.setenv("ETCD_TPU_FLIGHTREC_DIR",
+                           str(tmp_path / "rec"))
+        h = ChaosHarness(
+            str(tmp_path), 101,
+            FaultSpec(drop=0.05, dup=0.05, delay=0.08,
+                      delay_max_s=0.04, reorder=0.2),
+            num_members=R, num_groups=G, cfg=CFG, trace=True,
+        )
+        obs = LeaderObserver(h.alive)
+        try:
+            h.wait_leaders()
+            obs.start()
+            acked = h.run_workload(12)
+            assert acked >= 6, f"only {acked}/12 writes acked"
+            h.plan.quiesce()
+            run_invariant_checks(h, obs, expect_members=R)
+            assert h.invariant_trips() == 0
+            payloads = [m.tracer.to_payload()
+                        for m in h.members.values()]
+            dump_paths = h.dump_flight_recorders(reason="test")
+        finally:
+            obs.stop()
+            h.stop()
+        assert any("tracering_" in p for p in dump_paths), dump_paths
+        trace, stats = merge(payloads)
+        validate_chrome_trace(trace)
+        assert stats["spans_joined"] > 0

@@ -1,0 +1,120 @@
+"""Chrome-trace exporter (ISSUE 9): valid, round-trippable JSON;
+per-hop slices; validator rejects malformed objects."""
+
+import json
+
+import pytest
+
+from etcd_tpu.obs.export import (
+    HOP_NAMES,
+    chrome_trace,
+    span_events,
+    validate_chrome_trace,
+)
+from etcd_tpu.obs.tracer import STAGES
+
+
+def full_span(group=0, term=1, index=5, base=1000, step=1000):
+    return {
+        "group": group, "term": term, "index": index, "complete": True,
+        "stages": {s: base + i * step for i, s in enumerate(STAGES)},
+    }
+
+
+def payload(member, spans):
+    return {"member": member, "sample": 1, "seed": 0,
+            "stage_names": list(STAGES), "monotonic_ns": 0,
+            "wall_ns": 0, "spans": spans}
+
+
+class TestSpanEvents:
+    def test_one_slice_per_adjacent_hop(self):
+        evs = span_events(full_span(), pid=1)
+        assert len(evs) == len(STAGES) - 1
+        assert [e["name"] for e in evs] == [
+            HOP_NAMES[(a, b)] for a, b in zip(STAGES, STAGES[1:])]
+        # Slices tile the span exactly: each starts where the previous
+        # ended, each lasting step/1e3 us.
+        for e in evs:
+            assert e["dur"] == 1.0  # 1000 ns = 1 us
+        assert all(e["ph"] == "X" for e in evs)
+
+    def test_partial_fragment_skips_missing_stages(self):
+        """A peer fragment (extract/fsync/send only) yields its two
+        hops; no fabricated zero-duration slices."""
+        sp = {"group": 1, "term": 1, "index": 2, "complete": False,
+              "stages": {"extract": 100, "fsync": 300, "send": 350}}
+        evs = span_events(sp, pid=2)
+        assert [e["name"] for e in evs] == ["fsync", "send"]
+
+    def test_offset_shifts_timestamps(self):
+        evs0 = span_events(full_span(), pid=1)
+        evs1 = span_events(full_span(), pid=1, offset_ns=5000)
+        for a, b in zip(evs0, evs1):
+            assert b["ts"] == pytest.approx(a["ts"] + 5.0)
+
+    def test_clock_regression_clamps_duration(self):
+        """A stamp pair out of order (cross-thread stamp skew) must
+        not emit a negative duration (Perfetto rejects those)."""
+        sp = full_span()
+        sp["stages"]["fsync"] = sp["stages"]["extract"] - 500
+        evs = span_events(sp, pid=1)
+        assert all(e["dur"] >= 0 for e in evs)
+
+
+class TestChromeTrace:
+    def test_valid_and_json_round_trips(self):
+        obj = chrome_trace([
+            payload("1", [full_span(index=i) for i in range(3)]),
+            payload("2", [full_span(group=1)]),
+        ])
+        slices = validate_chrome_trace(obj)
+        assert len(slices) == 4 * (len(STAGES) - 1)
+        again = json.loads(json.dumps(obj))
+        assert validate_chrome_trace(again)
+
+    def test_member_lanes_and_metadata(self):
+        obj = chrome_trace([payload("1", []), payload("2", [])])
+        meta = [e for e in obj["traceEvents"] if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in meta} == {
+            "member-1", "member-2"}
+        assert obj["otherData"]["members"] == ["1", "2"]
+
+    def test_offsets_recorded_in_other_data(self):
+        obj = chrome_trace([payload("1", [])],
+                           offsets_ns={"1": 123})
+        assert obj["otherData"]["clock_offsets_ns"] == {"1": 123}
+
+
+class TestValidator:
+    def test_rejects_non_trace(self):
+        with pytest.raises(ValueError):
+            validate_chrome_trace({"events": []})
+        with pytest.raises(ValueError):
+            validate_chrome_trace({"traceEvents": "nope"})
+
+    def test_rejects_bad_phase_and_missing_fields(self):
+        with pytest.raises(ValueError, match="unknown phase"):
+            validate_chrome_trace(
+                {"traceEvents": [{"ph": "Z", "pid": 1, "name": "x"}]})
+        with pytest.raises(ValueError, match="missing"):
+            validate_chrome_trace(
+                {"traceEvents": [{"ph": "X", "name": "x"}]})
+        with pytest.raises(ValueError, match="missing ts"):
+            validate_chrome_trace(
+                {"traceEvents": [{"ph": "X", "pid": 1, "name": "x",
+                                  "tid": 0, "dur": 1}]})
+
+    def test_rejects_negative_duration(self):
+        with pytest.raises(ValueError, match="bad dur"):
+            validate_chrome_trace(
+                {"traceEvents": [{"ph": "X", "pid": 1, "tid": 0,
+                                  "name": "x", "ts": 0, "dur": -1}]})
+
+    def test_rejects_unserializable_args(self):
+        import numpy as np
+
+        obj = chrome_trace([payload("1", [full_span()])])
+        obj["traceEvents"][-1]["args"]["bad"] = np.int64(3)
+        with pytest.raises(TypeError):
+            validate_chrome_trace(obj)

@@ -1,0 +1,169 @@
+"""Cross-member merge (ISSUE 9): a synthetic 3-member span set with a
+KNOWN clock skew must reassemble exactly — offsets recovered, hops
+telescoping to the end-to-end latency, Perfetto-loadable output."""
+
+import pytest
+
+from etcd_tpu.obs.export import validate_chrome_trace
+from etcd_tpu.obs.merge import (
+    HOPS,
+    estimate_offsets,
+    hop_stats,
+    hops_markdown,
+    merge,
+)
+from etcd_tpu.obs.tracer import STAGES
+
+MS = 1_000_000  # ns
+
+# Ground-truth timeline on the ORIGIN's clock (ns), symmetric network
+# (net == commit - peer_send), so the NTP-style estimator is exact.
+ORIGIN_STAGES = {
+    "propose": 0 * MS, "stage": 1 * MS, "dispatch": 2 * MS,
+    "extract": 3 * MS, "fsync": 4 * MS, "send": 5 * MS,
+    "commit": 9 * MS, "apply": 10 * MS,
+}
+NET = 1 * MS
+PEER_TRUE = {"extract": 6 * MS, "fsync": 7 * MS, "send": 8 * MS}
+# Member clock shifts: member m's monotonic clock reads true + shift.
+SHIFT = {"1": 0, "2": 5 * MS, "3": -3 * MS}
+
+
+def synthetic_payloads(n_spans=4):
+    """Origin member 1, peers 2 and 3; every span identical modulo its
+    (group, index) key. commit - peer_send == NET on both sides, so
+    offset recovery is exact (the median of identical samples)."""
+    payloads = []
+    for member in ("1", "2", "3"):
+        spans = []
+        for k in range(n_spans):
+            true = ORIGIN_STAGES if member == "1" else PEER_TRUE
+            spans.append({
+                "group": k % 2, "term": 2, "index": 5 + k,
+                "complete": member == "1",
+                "stages": {s: t + SHIFT[member] for s, t in true.items()},
+            })
+        payloads.append({
+            "member": member, "sample": 1, "seed": 0,
+            "stage_names": list(STAGES),
+            "monotonic_ns": 0, "wall_ns": 0, "spans": spans,
+        })
+    return payloads
+
+
+class TestOffsetRecovery:
+    def test_known_skew_recovered_exactly(self):
+        offsets = estimate_offsets(synthetic_payloads())
+        # The offset to ADD to a member's stamps to land on member 1's
+        # clock is -shift.
+        assert offsets == {"1": 0, "2": -5 * MS, "3": 3 * MS}
+
+    def test_reference_member_is_zero(self):
+        offsets = estimate_offsets(synthetic_payloads())
+        assert offsets["1"] == 0
+
+    def test_unpaired_member_defaults_to_zero(self):
+        payloads = synthetic_payloads()
+        payloads.append({"member": "9", "spans": [],
+                         "monotonic_ns": 0, "wall_ns": 0})
+        assert estimate_offsets(payloads)["9"] == 0
+
+
+class TestHopDecomposition:
+    def test_hops_telescope_to_e2e(self):
+        """The named hops are consecutive intervals: their sum IS the
+        propose→apply end-to-end, so coverage is exactly 1.0 — the
+        acceptance bar's ≥0.90 has slack only for real-run stamp
+        jitter, not for decomposition gaps."""
+        stats = hop_stats(synthetic_payloads())
+        assert stats["spans_origin"] == 4
+        assert stats["spans_peer_decomposed"] == 4
+        assert set(stats["hops"]) == {name for name, _a, _b in HOPS}
+        assert stats["hop_p50_sum_ms"] == pytest.approx(
+            stats["e2e_apply"]["p50_ms"])
+        assert stats["hop_coverage_of_e2e_p50"] == pytest.approx(1.0)
+        # The commit decomposition's mean identity is exact BY
+        # CONSTRUCTION for any decomposed population (sum of hop means
+        # == mean of per-span commit totals), not just for identical
+        # spans.
+        cd = stats["commit_decomposition"]
+        assert cd["coverage_of_commit_mean"] == pytest.approx(1.0)
+        assert cd["hop_mean_sum_ms"] == pytest.approx(
+            cd["e2e_commit_mean_ms"])
+        assert cd["coverage_of_commit_p50"] == pytest.approx(1.0)
+        assert stats["hops_population"] == "decomposed"
+
+    def test_commit_mean_identity_survives_heterogeneous_spans(self):
+        """Spans that split the same total differently across hops
+        (the anti-correlated-share shape wave scheduling produces)
+        keep the mean identity exact even as the p50 sum undershoots."""
+        payloads = synthetic_payloads(n_spans=6)
+        for k, sp in enumerate(payloads[0]["spans"]):
+            # Shift time between fsync and enqueue_wait per span: the
+            # propose→commit total is unchanged, the shares move.
+            delta = (k - 2) * MS // 4
+            sp["stages"]["stage"] = sp["stages"]["stage"] + delta
+        stats = hop_stats(payloads)
+        cd = stats["commit_decomposition"]
+        assert cd["coverage_of_commit_mean"] == pytest.approx(1.0)
+
+    def test_hop_values_match_ground_truth(self):
+        stats = hop_stats(synthetic_payloads())
+        expect_ms = {
+            "enqueue_wait": 1, "stage": 1, "step": 1, "fsync": 1,
+            "send": 1, "net_to_peer": 1, "peer_fsync": 1,
+            "peer_ack": 1, "ack_to_commit": 1, "apply": 1,
+        }
+        for name, ms in expect_ms.items():
+            assert stats["hops"][name]["p50_ms"] == pytest.approx(ms), name
+        assert stats["e2e_commit"]["p50_ms"] == pytest.approx(9.0)
+        assert stats["e2e_apply"]["p50_ms"] == pytest.approx(10.0)
+
+    def test_quorum_peer_is_the_fastest_ack(self):
+        """With one peer slower by 2ms (skew-corrected), the
+        decomposition must follow the FASTER ack — that is the one
+        that formed the quorum."""
+        payloads = synthetic_payloads(n_spans=2)
+        for sp in payloads[2]["spans"]:  # member 3: slow its ack
+            sp["stages"] = {s: t + 2 * MS
+                            for s, t in sp["stages"].items()}
+        stats = hop_stats(payloads)
+        # Fast peer (member 2) still gives peer hops of exactly 1ms.
+        assert stats["hops"]["peer_fsync"]["p50_ms"] == pytest.approx(1)
+        assert stats["hops"]["net_to_peer"]["p50_ms"] == pytest.approx(
+            1, abs=0.5)
+
+
+class TestMergedTrace:
+    def test_merge_emits_perfetto_loadable_json(self):
+        trace, stats = merge(synthetic_payloads())
+        slices = validate_chrome_trace(trace)
+        assert len(slices) > 0
+        # All three member lanes present, offsets recorded.
+        assert trace["otherData"]["members"] == ["1", "2", "3"]
+        assert trace["otherData"]["clock_offsets_ns"]["2"] == -5 * MS
+        assert stats["spans_joined"] == 4
+
+    def test_markdown_table_lists_every_hop(self):
+        _trace, stats = merge(synthetic_payloads())
+        md = hops_markdown(stats)
+        for name, _a, _b in HOPS:
+            assert name in md
+        assert "e2e_commit" in md and "e2e_apply" in md
+
+
+class TestDegenerateInputs:
+    def test_single_member_payload_still_merges(self):
+        (p1, _p2, _p3) = synthetic_payloads()
+        trace, stats = merge([p1])
+        validate_chrome_trace(trace)
+        # No peer fragments: origin-local hops only, no peer hops.
+        assert "peer_fsync" not in stats["hops"]
+        assert stats["spans_origin"] == 4
+
+    def test_empty_payloads(self):
+        trace, stats = merge([{"member": "1", "spans": [],
+                               "monotonic_ns": 0, "wall_ns": 0}])
+        validate_chrome_trace(trace)
+        assert stats["spans_joined"] == 0
+        assert stats["hops"] == {}

@@ -1,0 +1,154 @@
+"""Tracer core (ISSUE 9): deterministic sampling, first-stamp-wins,
+bounded rings with counted eviction, dump round-trip. Pure host tests —
+no engine, no jax."""
+
+import json
+
+import numpy as np
+import pytest
+
+from etcd_tpu.obs.tracer import STAGES, Tracer, make_tracer
+from etcd_tpu.pkg import metrics as pmet
+
+
+def mk(member="1", sample=1, seed=0, ring=8192, **kw):
+    # Isolated registry per tracer: counter asserts must not see other
+    # tests' increments.
+    return Tracer(member=member, sample=sample, seed=seed, ring=ring,
+                  registry=pmet.Registry(), **kw)
+
+
+class TestSampling:
+    def test_every_member_decides_identically(self):
+        """The join depends on every member sampling the same keys:
+        same (group, index, seed) => same decision, whatever the
+        member id."""
+        a, b = mk("1", sample=8, seed=42), mk("2", sample=8, seed=42)
+        for g in range(16):
+            for i in range(64):
+                assert a.sampled(g, i) == b.sampled(g, i)
+
+    def test_vectorized_matches_scalar(self):
+        t = mk(sample=8, seed=7)
+        g = np.repeat(np.arange(32), 8)
+        i = np.tile(np.arange(8), 32)
+        vec = t.sampled_arr(g, i)
+        ref = np.array([t.sampled(int(gg), int(ii))
+                        for gg, ii in zip(g, i)])
+        assert (vec == ref).all()
+
+    def test_seed_moves_the_population(self):
+        a, b = mk(sample=8, seed=0), mk(sample=8, seed=12345)
+        keys = [(g, i) for g in range(8) for i in range(64)]
+        pa = {k for k in keys if a.sampled(*k)}
+        pb = {k for k in keys if b.sampled(*k)}
+        assert pa != pb  # different seeds pick different proposals
+
+    def test_rate_is_approximately_one_in_n(self):
+        t = mk(sample=16)
+        hits = int(t.sampled_arr(
+            np.zeros(4096, np.int64), np.arange(4096)).sum())
+        # Loose band: the mix is a hash, not a counter.
+        assert 4096 // 16 * 0.5 < hits < 4096 // 16 * 2
+
+    def test_sample_one_traces_everything(self):
+        t = mk(sample=1)
+        assert t.sampled_arr(np.arange(100), np.arange(100)).all()
+
+
+class TestStamping:
+    def test_first_stamp_wins(self):
+        """A retransmitted append must not move an already-taken
+        stamp."""
+        t = mk()
+        t.stamp(0, 1, 5, "fsync", t_ns=100)
+        t.stamp(0, 1, 5, "fsync", t_ns=999)
+        (sp,) = t.spans()
+        assert sp["stages"]["fsync"] == 100
+
+    def test_apply_retires_the_span(self):
+        t = mk()
+        for stage, ts in zip(STAGES, range(len(STAGES))):
+            t.stamp(3, 2, 7, stage, t_ns=ts)
+        (sp,) = t.spans(include_open=False)
+        assert sp["complete"] is True
+        assert sp["group"] == 3 and sp["term"] == 2 and sp["index"] == 7
+        assert list(sp["stages"]) == list(STAGES)
+
+    def test_open_cap_evicts_oldest_and_counts(self):
+        t = mk()
+        for i in range(t.OPEN_CAP + 10):
+            t.stamp(0, 1, i, "stage", t_ns=i)
+        retired = t.spans(include_open=False)
+        assert len(retired) == 10
+        assert all(not sp["complete"] for sp in retired)
+        # Oldest-first: indexes 0..9 were evicted.
+        assert [sp["index"] for sp in retired] == list(range(10))
+
+    def test_ring_bound_evicts(self):
+        t = mk(ring=4)
+        for i in range(8):
+            t.stamp(0, 1, i, "apply", t_ns=i)  # retire immediately
+        retired = t.spans(include_open=False)
+        assert len(retired) == 4
+        assert [sp["index"] for sp in retired] == [4, 5, 6, 7]
+
+    def test_stamp_many_shares_one_instant(self):
+        t = mk()
+        keys = [(0, 1, 1), (0, 1, 2), (1, 1, 3)]
+        t.stamp_many(keys, "fsync", t_ns=777)
+        spans = {sp["index"]: sp for sp in t.spans()}
+        assert all(spans[i]["stages"]["fsync"] == 777 for i in (1, 2, 3))
+
+
+class TestDump:
+    def test_dump_payload_round_trips(self, tmp_path):
+        t = mk(dump_dir=str(tmp_path))
+        t.stamp(0, 1, 1, "propose", t_ns=10)
+        t.stamp(0, 1, 1, "stage", t_ns=20)
+        path = t.dump(reason="unit")
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["member"] == "1"
+        assert payload["reason"] == "unit"
+        assert payload["stage_names"] == list(STAGES)
+        (sp,) = payload["spans"]
+        assert sp["stages"] == {"propose": 10, "stage": 20}
+        # Paired clock anchors present (the merge's coarse fallback).
+        assert payload["monotonic_ns"] > 0 and payload["wall_ns"] > 0
+
+
+class TestMakeTracer:
+    def test_disabled_returns_none(self, monkeypatch):
+        monkeypatch.delenv("ETCD_TPU_TRACE", raising=False)
+        assert make_tracer("1") is None
+        assert make_tracer("1", enabled=False) is None
+
+    def test_env_enable_and_tuning(self, monkeypatch):
+        monkeypatch.setenv("ETCD_TPU_TRACE", "1")
+        monkeypatch.setenv("ETCD_TPU_TRACE_SAMPLE", "5")
+        monkeypatch.setenv("ETCD_TPU_TRACE_SEED", "9")
+        t = make_tracer("2", registry=pmet.Registry())
+        assert t is not None
+        assert (t.member, t.sample, t.seed) == ("2", 5, 9)
+
+    def test_explicit_enable_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("ETCD_TPU_TRACE", "0")
+        assert make_tracer("1", enabled=True,
+                           registry=pmet.Registry()) is not None
+
+
+class TestDropCounters:
+    def test_evictions_are_never_silent(self):
+        """Every shed span lands on a labeled drop counter — the
+        merged timeline's gaps are explainable from metrics alone."""
+        reg = pmet.Registry()
+        t = Tracer(member="9", sample=1, ring=2, registry=reg)
+        for i in range(t.OPEN_CAP + 3):
+            t.stamp(0, 1, i, "stage", t_ns=i)
+        assert t._drops.labels("9", "open_evict").value() == 3
+        # Retire enough spans to overflow the 2-slot ring too.
+        for i in range(4):
+            t.stamp(1, 1, i, "apply", t_ns=i)
+        assert t._drops.labels("9", "ring_evict").value() >= 1
+        assert t._spans_c.value() == t.OPEN_CAP + 3 + 4

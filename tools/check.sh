@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
-# One-shot static gate (ISSUE 7): ruff + jitlint + runtime-sentinel
-# smoke (transfer guard, recompile budget, lock order). CI runs exactly
-# this script (.github/workflows/lint.yml); run it locally before
-# pushing anything that touches the batched hot path.
+# One-shot static gate (ISSUE 7, grown by ISSUE 9): ruff + jitlint +
+# runtime-sentinel smoke (transfer guard, recompile budget, lock
+# order) + trace smoke (one traced in-proc round, exporter validated)
+# + bench-history re-emit. CI runs exactly this script
+# (.github/workflows/lint.yml); run it locally before pushing anything
+# that touches the batched hot path.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -21,5 +23,11 @@ python tools/jitlint.py \
 
 echo "== sentinel smoke (transfer guard, recompile budget, lock order) =="
 python -m pytest tests/analysis tests/batched/test_sentinels.py -q
+
+echo "== trace smoke (one traced in-proc round, exporter validates) =="
+python tools/trace_smoke.py
+
+echo "== bench history (artifacts/bench_history.json + BENCH_HISTORY.md) =="
+python tools/bench_history.py
 
 echo "check.sh: all gates green"

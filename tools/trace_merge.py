@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Merge per-member trace-ring dumps into one cross-member timeline.
+
+Input: two or more tracer payloads (``Tracer.to_payload()`` JSON — the
+admin 'trace' op's inline payload, or ``tracering_*.json`` dumps from
+``Tracer.dump`` / the chaos harness). Output: a Perfetto-loadable
+Chrome-trace JSON of every member's spans on one aligned clock, plus a
+per-hop latency table decomposing commit latency into named hops
+(propose→stage→step→fsync→send→peer-fsync→ack→commit→apply).
+
+The join/offset-estimation machinery lives in ``etcd_tpu.obs.merge``
+(importable — tools/hosted_bench.py builds its SLO table from it); this
+is the command-line face:
+
+    python tools/trace_merge.py m1.json m2.json m3.json \
+        [-o merged_trace.json] [--table HOPS.md] [--json stats.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from etcd_tpu.obs.export import validate_chrome_trace  # noqa: E402
+from etcd_tpu.obs.merge import (  # noqa: E402
+    hops_markdown,
+    load_payload,
+    merge,
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-member trace dumps into one timeline")
+    ap.add_argument("dumps", nargs="+", help="tracering_*.json paths")
+    ap.add_argument("-o", "--out", default="artifacts/merged_trace.json",
+                    help="merged Chrome-trace JSON (Perfetto-loadable)")
+    ap.add_argument("--table", default="",
+                    help="also write the hop table as markdown")
+    ap.add_argument("--json", dest="stats_json", default="",
+                    help="also write hop stats as JSON")
+    args = ap.parse_args(argv)
+    payloads = [load_payload(p) for p in args.dumps]
+    trace, stats = merge(payloads)
+    validate_chrome_trace(trace)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    md = hops_markdown(stats)
+    if args.table:
+        with open(args.table, "w") as f:
+            f.write(f"# Commit-path hop decomposition\n\n{md}")
+    if args.stats_json:
+        with open(args.stats_json, "w") as f:
+            json.dump(stats, f, indent=1)
+            f.write("\n")
+    print(md)
+    print(f"merged trace: {args.out} "
+          f"({stats['spans_joined']} spans, offsets "
+          f"{stats['clock_offsets_ns']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Trace smoke for tools/check.sh (ISSUE 9): drive one traced
+proposal through a tiny 3-member in-process round set, then validate
+the merged export is Perfetto-loadable Chrome-trace JSON. One tiny
+compile (~seconds on CPU), no sockets, no threads — a broken stamp
+hook or exporter regression fails the static gate, not a hosted run.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from etcd_tpu.batched.rawnode import BatchedRawNode  # noqa: E402
+from etcd_tpu.batched.state import BatchedConfig  # noqa: E402
+from etcd_tpu.obs.export import validate_chrome_trace  # noqa: E402
+from etcd_tpu.obs.merge import merge  # noqa: E402
+from etcd_tpu.obs.tracer import STAGES, Tracer  # noqa: E402
+
+G, R = 2, 3
+
+
+def main() -> int:
+    cfg = BatchedConfig(
+        num_groups=G, num_replicas=R, window=8, max_ents_per_msg=2,
+        max_props_per_round=1, election_timeout=1 << 20,
+        heartbeat_timeout=4,
+    )
+    rns = {}
+    for mid in range(1, R + 1):
+        rn = BatchedRawNode(
+            cfg, groups=np.arange(G, dtype=np.int32),
+            slots=np.full(G, mid - 1, np.int32))
+        rn.tracer = Tracer(member=str(mid), sample=1)
+        rns[mid] = rn
+
+    def pump(rounds):
+        for _ in range(rounds):
+            for mid, rn in rns.items():
+                rd = rn.advance_round()
+                blk = rd.msg_block
+                if blk is not None and len(blk):
+                    for to, sub in blk.split_by_target().items():
+                        rns[to].step_block(sub)
+                for row, m in rd.messages:
+                    rns[m.to].step(row, m)
+                rn.tracer.stamp_many(rd.traced_entries, "fsync")
+                rn.tracer.stamp_many(rd.traced_entries, "send")
+                rn.tracer.stamp_many(rd.traced_commit, "apply")
+                rn.advance()
+
+    rns[1].campaign(np.arange(G))
+    pump(5)
+    for g in range(G):
+        rns[1].propose(g, b"smoke")
+    pump(6)
+
+    payloads = [rn.tracer.to_payload() for rn in rns.values()]
+    trace, stats = merge(payloads)
+    slices = validate_chrome_trace(trace)
+    origin = [sp for sp in payloads[0]["spans"]
+              if sp.get("complete") and "propose" in sp["stages"]]
+    if len(origin) != G:
+        print(f"trace smoke: expected {G} completed proposal spans on "
+              f"the leader, got {len(origin)}", file=sys.stderr)
+        return 1
+    missing = set(STAGES) - set(origin[0]["stages"])
+    if missing:
+        print(f"trace smoke: span missing stages {missing}",
+              file=sys.stderr)
+        return 1
+    if stats["spans_peer_decomposed"] < G:
+        print(f"trace smoke: only {stats['spans_peer_decomposed']}/{G} "
+              f"spans peer-decomposed", file=sys.stderr)
+        return 1
+    print(f"trace smoke OK: {stats['spans_joined']} spans joined, "
+          f"{len(slices)} slices, hop sum "
+          f"{stats['hop_p50_sum_ms']}ms / e2e "
+          f"{stats['e2e_apply'].get('p50_ms')}ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
