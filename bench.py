@@ -82,13 +82,14 @@ def _ensure_live_backend() -> None:
 
 def _make_engine(groups: int, lanes_minor: bool,
                  merged_deliver: bool = False,
-                 telemetry: bool = False):
+                 telemetry: bool = False,
+                 fleet: bool = False):
     # Canonical config + setup shared with tools/frontier_sweep.py so
     # the two tools' numbers stay methodologically comparable.
     from etcd_tpu.tools.benchlib import make_bench_engine
 
     return make_bench_engine(groups, lanes_minor, merged_deliver,
-                             telemetry=telemetry)
+                             telemetry=telemetry, fleet=fleet)
 
 
 def _rate(eng, props, rounds_per_call: int, calls: int,
@@ -143,6 +144,13 @@ def main() -> None:
         raise SystemExit(
             f"BENCH_TELEMETRY must be 0|1, got {tel_env!r}")
     telemetry = tel_env == "1"
+    # BENCH_FLEET=1 compiles the fleet-summary plane (ISSUE 10) into
+    # the measured round — the overhead knob backing the BENCH_NOTES
+    # fleet row (tools/fleet_overhead.py interleaves on/off runs).
+    flt_env = os.environ.get("BENCH_FLEET", "")
+    if flt_env and flt_env not in ("0", "1"):
+        raise SystemExit(f"BENCH_FLEET must be 0|1, got {flt_env!r}")
+    fleet = flt_env == "1"
     cached = None  # (eng, props) reusable for the main run
     if layout_env:
         lanes_minor = layout_env == "minor"
@@ -162,7 +170,7 @@ def main() -> None:
             try:
                 t0 = time.perf_counter()
                 engines[lm] = _make_engine(min(groups, 4096), lm, merged,
-                                           telemetry)
+                                           telemetry, fleet)
                 _note(f"probe layout={'minor' if lm else 'major'} "
                       f"built+compiled in {time.perf_counter()-t0:.1f}s")
                 rates[lm] = _rate(*engines[lm], 8, 2)
@@ -182,14 +190,14 @@ def main() -> None:
         try:
             t0 = time.perf_counter()
             eng, props = _make_engine(groups, lanes_minor, merged,
-                                      telemetry)
+                                      telemetry, fleet)
         except Exception as e:  # noqa: BLE001 — one-shot layout fallback
             _note(f"layout={'minor' if lanes_minor else 'major'} failed "
                   f"({e!r}); falling back to the other layout")
             lanes_minor = not lanes_minor
             t0 = time.perf_counter()
             eng, props = _make_engine(groups, lanes_minor, merged,
-                                      telemetry)
+                                      telemetry, fleet)
         _note(f"main G={groups} built+compiled in {time.perf_counter()-t0:.1f}s")
     rate = _rate(eng, props, 16, 8, pipelined=pipelined)
     _note(f"main rate: {rate:.0f} group-rounds/s")
@@ -211,6 +219,7 @@ def main() -> None:
                     f"deliver={'merged' if merged else 'six'}, "
                     f"loop={'pipelined' if pipelined else 'serial'}, "
                     f"telemetry={'on' if telemetry else 'off'}, "
+                    f"fleet={'on' if fleet else 'off'}, "
                     f"commit_p50={commit_p50_ms:.2f}ms/{rounds}r)"
                 ),
                 "vs_baseline": round(rate / 1e6, 4),

@@ -57,26 +57,61 @@ class MultiRaftEngine:
             self._tel_counters = jnp.zeros((n, NUM_COUNTERS), I32)
             self._tel_invariants = jnp.zeros((n,), I32)
         self.telemetry_hub = None
+        # Step output positions past (state, outbox): aux is absent on
+        # the engine's step (with_aux=False), then telemetry, then the
+        # fleet summary vector — indexed here once instead of fragile
+        # out[-1] reads that break when a second plane is on.
+        self._tel_pos = 2
+        self._fleet_pos = 2 + (1 if cfg.telemetry else 0)
+        # In-device fleet-summary accumulator (cfg.fleet_summary): one
+        # flat [L] i32 frame; delta fields (sum_mask) add across
+        # rounds, snapshot fields keep the latest round's value — both
+        # inside the scan carry, zero per-round host sync.
+        if cfg.fleet_summary:
+            from ..obs.fleet import FleetLayout
 
-        def closed_loop(st, inbox, ticks, props, tel, rounds):
+            self._fleet_layout = FleetLayout(
+                n, cfg.num_replicas, cfg.num_groups)
+            self._fleet_vec = jnp.zeros((self._fleet_layout.size,), I32)
+            self._fleet_summask = jnp.asarray(
+                self._fleet_layout.sum_mask())
+            # The device carry is i32 and its ACC_SUM fields aggregate
+            # ALL rows into a few buckets (hist_commit_delta gains N
+            # counts per round), so an undrained closed loop would
+            # wrap after ~2^31/N rounds at large G — silently, and
+            # ingest_totals' delta clamp would then eat every later
+            # frame. drain_fleet() folds the device sums into this
+            # i64 host base and RESETS them, so the public totals are
+            # unbounded while the on-device window stays small; any
+            # consumer that reads the histograms drains periodically
+            # (the hosted path ingests per round and never uses this).
+            self._fleet_base = np.zeros(self._fleet_layout.size,
+                                        np.int64)
+            self._fleet_sum_np = self._fleet_layout.sum_mask()
+        self.fleet_hub = None
+
+        def closed_loop(st, inbox, ticks, props, tel, flt, rounds):
             def body(carry, _):
-                st, inbox, tel = carry
+                st, inbox, tel, flt = carry
                 out = self._step(
                     st, inbox, ticks, self._zeros_b, props, self._zeros_b
                 )
                 st, outbox = out[:2]
                 if cfg.telemetry:
-                    fr = out[-1]
+                    fr = out[self._tel_pos]
                     tel = (tel[0] + fr.counters, tel[1] | fr.invariants)
-                return (st, route(cfg, outbox), tel), None
+                if cfg.fleet_summary:
+                    fv = out[self._fleet_pos]
+                    flt = jnp.where(self._fleet_summask, flt + fv, fv)
+                return (st, route(cfg, outbox), tel, flt), None
 
-            (st, inbox, tel), _ = jax.lax.scan(
-                body, (st, inbox, tel), None, length=rounds
+            (st, inbox, tel, flt), _ = jax.lax.scan(
+                body, (st, inbox, tel, flt), None, length=rounds
             )
             # The scalar fence is a SEPARATE output buffer: pipelined
             # callers block on it to bound queue depth without holding
             # (and thereby breaking) a donated state buffer.
-            return st, inbox, tel, st.commit[0]
+            return st, inbox, tel, flt, st.commit[0]
 
         # State and inbox are donated: run_rounds/run_rounds_pipelined
         # reassign both from the return value, so XLA writes round k+1
@@ -130,9 +165,13 @@ class MultiRaftEngine:
             )
             self.state, outbox = out[:2]
             if self.cfg.telemetry:
-                fr = out[-1]
+                fr = out[self._tel_pos]
                 self._tel_counters = self._tel_counters + fr.counters
                 self._tel_invariants = self._tel_invariants | fr.invariants
+            if self.cfg.fleet_summary:
+                fv = out[self._fleet_pos]
+                self._fleet_vec = jnp.where(
+                    self._fleet_summask, self._fleet_vec + fv, fv)
             self.inbox = route(self.cfg, outbox)
 
     def _tel(self):
@@ -145,6 +184,16 @@ class MultiRaftEngine:
         if self.cfg.telemetry:
             self._tel_counters, self._tel_invariants = tel
 
+    def _flt(self):
+        """Fleet-summary carry for the closed loop (empty when off)."""
+        if self.cfg.fleet_summary:
+            return self._fleet_vec
+        return ()
+
+    def _set_flt(self, flt) -> None:
+        if self.cfg.fleet_summary:
+            self._fleet_vec = flt
+
     def run_rounds(self, rounds: int, tick: bool = True,
                    propose_n: Optional[jnp.ndarray] = None) -> None:
         """Closed-loop simulation of `rounds` rounds without leaving the
@@ -154,10 +203,12 @@ class MultiRaftEngine:
         # `rounds` is a static arg: each new value compiles a new scan
         # program, so warmth (and thus the transfer guard) is per value.
         with warm_guard(f"closed_loop/{self._serial}/{rounds}"):
-            self.state, self.inbox, tel, _ = self._closed_loop(
-                self.state, self.inbox, ticks, props, self._tel(), rounds
+            self.state, self.inbox, tel, flt, _ = self._closed_loop(
+                self.state, self.inbox, ticks, props, self._tel(),
+                self._flt(), rounds
             )
         self._set_tel(tel)
+        self._set_flt(flt)
 
     def run_rounds_pipelined(self, rounds: int, chunk: int = 16,
                              depth: int = 2, tick: bool = True,
@@ -186,10 +237,12 @@ class MultiRaftEngine:
         while done < rounds:
             n = min(chunk, rounds - done)
             with warm_guard(f"closed_loop/{self._serial}/{n}"):
-                self.state, self.inbox, tel, fence = self._closed_loop(
-                    self.state, self.inbox, ticks, props, self._tel(), n
+                self.state, self.inbox, tel, flt, fence = self._closed_loop(
+                    self.state, self.inbox, ticks, props, self._tel(),
+                    self._flt(), n
                 )
             self._set_tel(tel)
+            self._set_flt(flt)
             done += n
             fences.append(fence)
             while len(fences) > depth:
@@ -265,6 +318,35 @@ class MultiRaftEngine:
         if hub is not None:
             hub.ingest_totals(counters, inv)
         return counters, inv
+
+    # -- fleet summary (device → host gather; cfg.fleet_summary only) ---------
+
+    def fleet_frame(self) -> np.ndarray:
+        """The accumulated [L] SummaryFrame (obs/fleet.FleetLayout
+        order, int64): delta fields are monotone sums across rounds
+        (device window + drained i64 base — see __init__), snapshot
+        fields hold the LAST round's census/top-k. One host gather; no
+        per-round sync ever happened."""
+        assert self.cfg.fleet_summary, (
+            "engine built with fleet_summary=False")
+        vec = np.asarray(self._fleet_vec).astype(np.int64)
+        return np.where(self._fleet_sum_np, self._fleet_base + vec, vec)
+
+    def drain_fleet(self, hub=None) -> np.ndarray:
+        """Fold the accumulated frame into `hub` (or the attached
+        ``fleet_hub``) via its monotone-totals path, then bank the
+        device window's sums into the i64 base and reset them on
+        device (bounds the i32 carry far below wrap); returns the
+        fetched monotone vector."""
+        dev = np.asarray(self._fleet_vec).astype(np.int64)
+        vec = np.where(self._fleet_sum_np, self._fleet_base + dev, dev)
+        hub = hub or self.fleet_hub
+        if hub is not None:
+            hub.ingest_totals(vec)
+        self._fleet_base += np.where(self._fleet_sum_np, dev, 0)
+        self._fleet_vec = jnp.where(
+            self._fleet_summask, 0, self._fleet_vec)
+        return vec
 
     # -- observation (device → host gathers, debug/Ready watermarks) ----------
 

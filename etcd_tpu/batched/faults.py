@@ -371,6 +371,12 @@ class ChaosHarness:
             # instrumentation to even find) and a checker failure dumps
             # every member's flight recorder.
             telemetry=True,
+            # ... and with the fleet observatory on (ISSUE 10): the
+            # device summary must be a pure observer even under faults
+            # (strict parity + invariant_trips()==0 holds with it on),
+            # and a checker failure freezes the groups×time heatmap
+            # rings beside the flight recorders.
+            fleet_summary=True,
         )
         self.transport = transport
         self.tick_interval = tick_interval
@@ -645,9 +651,11 @@ class ChaosHarness:
         return acked
 
     def dump_flight_recorders(self, reason: str = "chaos") -> List[str]:
-        """Dump every live member's telemetry flight recorder AND
-        trace-span ring (no-ops for whichever plane is off); returns
-        the paths."""
+        """Dump every live member's telemetry flight recorder, fleet
+        heatmap ring AND trace-span ring (no-ops for whichever plane
+        is off); returns the paths. All three share the obs.artifacts
+        naming scheme, so simultaneous multi-member dumps never
+        overwrite each other."""
         paths = []
         for m in self.members.values():
             hub = getattr(m, "hub", None)
@@ -656,6 +664,13 @@ class ChaosHarness:
                     paths.append(hub.dump(reason=reason))
                 except OSError:
                     _log.exception("flight-recorder dump failed (m%d)",
+                                   m.id)
+            fleet = getattr(m, "fleet", None)
+            if fleet is not None:
+                try:
+                    paths.append(fleet.dump(reason=reason))
+                except OSError:
+                    _log.exception("fleet-heatmap dump failed (m%d)",
                                    m.id)
             tracer = getattr(m, "tracer", None)
             if tracer is not None:

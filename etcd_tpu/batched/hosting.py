@@ -355,6 +355,19 @@ class MultiRaftMember:
         self.hub: Optional[TelemetryHub] = None
         self._h_fsync = None
         self._h_phase = None
+        # Fleet observatory (cfg.fleet_summary, obs/fleet.py): the
+        # rawnode folds every round's device SummaryFrame into this
+        # hub — etcd_tpu_fleet_* families, the bounded groups×time
+        # heatmap ring (admin 'fleet' op / fleet_console read it), and
+        # counted anomaly flags (commit_frozen, leader_skew).
+        self.fleet = None
+        if self.cfg.fleet_summary:
+            from ..obs.fleet import FleetHub
+
+            self.fleet = FleetHub(
+                num_groups, self.cfg.num_replicas, num_groups,
+                member=str(member_id))
+            self.rn.fleet_hub = self.fleet
         if self.cfg.telemetry:
             self.hub = TelemetryHub(num_groups, member=str(member_id))
             self.rn.telemetry_hub = self.hub

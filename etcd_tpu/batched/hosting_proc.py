@@ -185,6 +185,23 @@ class AdminServer:
                 return {"ok": True, "path": path,
                         "spans": m.tracer.span_count()}
             return {"ok": True, "payload": m.tracer.to_payload()}
+        if op == "fleet":
+            # Fleet observatory (obs/fleet.py): inline rollup of the
+            # latest device SummaryFrame — leader balance, top-K
+            # laggards with group ids, fenced/role/progress censuses,
+            # anomaly flags — or a groups×time heatmap ring dump with
+            # {"dump": true}. tools/fleet_console.py renders the
+            # rollups of every member as a live cluster view.
+            if m.fleet is None:
+                return {"err": "fleet summary disabled (start the "
+                               "member with --fleet)"}
+            if req.get("dump"):
+                path = m.fleet.dump(reason=req.get("reason", "admin"))
+                return {"ok": True, "path": path,
+                        "frames": m.fleet.frames()}
+            return {"ok": True, "rollup": m.fleet.snapshot(),
+                    "invariant_trips": (m.hub.trips()
+                                        if m.hub is not None else None)}
         if op == "flightrec":
             # Dump the member's flight recorder (last K rounds of
             # per-group telemetry deltas) to a JSON file on demand.
@@ -307,6 +324,17 @@ class AdminServer:
             "lat_ms_samples": [round(x, 2) for x in lat_ms],
         }
 
+    def close(self) -> None:
+        """Close the listening socket WITHOUT exiting the process —
+        the in-process embedding path (tools/fleet_smoke.py hosts
+        AdminServers around in-proc members); the worker-process path
+        keeps using the 'stop' op → _shutdown → os._exit contract."""
+        self._stopping.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
     def _shutdown(self) -> None:
         self._stopping.set()
         try:
@@ -329,6 +357,7 @@ def serve(member_id: int, num_members: int, num_groups: int,
           window: int = 32,
           tick_interval: float = 0.1,
           telemetry: bool = False,
+          fleet: bool = False,
           trace: Optional[bool] = None) -> None:
     from .hosting import MultiRaftMember
     from .state import BatchedConfig
@@ -347,6 +376,9 @@ def serve(member_id: int, num_members: int, num_groups: int,
         # --telemetry: kernel counters + invariant sweep + flight
         # recorder, served through the admin 'metrics'/'flightrec' ops.
         telemetry=telemetry,
+        # --fleet: device-side fleet SummaryFrame + FleetHub, served
+        # through the admin 'fleet' op (tools/fleet_console.py).
+        fleet_summary=fleet,
     )
     member = MultiRaftMember(
         member_id, num_members, num_groups, data_dir, cfg=cfg,
@@ -379,6 +411,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--telemetry", action="store_true",
                    help="enable the kernel telemetry plane (metrics + "
                         "flight recorder via the admin API)")
+    p.add_argument("--fleet", action="store_true",
+                   help="enable the fleet observatory (device-side "
+                        "group-state summary frames; admin 'fleet' op "
+                        "+ etcd_tpu_fleet_* metrics + heatmap ring — "
+                        "see tools/fleet_console.py)")
     p.add_argument("--trace", action="store_true",
                    help="enable proposal-lifecycle tracing (sampled "
                         "span stamps; admin 'trace' op serves the "
@@ -396,7 +433,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     serve(a.id, a.members, a.groups, a.data_dir, hp(a.bind),
           hp(a.admin), peers, window=a.window,
           tick_interval=a.tick_interval, telemetry=a.telemetry,
-          trace=a.trace or None)
+          fleet=a.fleet, trace=a.trace or None)
 
 
 # -- client side ---------------------------------------------------------------

@@ -194,6 +194,42 @@ def invariant_bits(st, slot) -> jnp.ndarray:
     return bits
 
 
+def log_bucket_index(v: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    """Log2 bucket of each non-negative value: bucket 0 holds v == 0,
+    bucket b (1..num_buckets-2) holds v in [2^(b-1), 2^b), the last
+    bucket is open-ended — the fleet-summary histogram discipline
+    (obs/fleet.BUCKET_BOUNDS mirrors this host-side).
+
+    Branch- and gather-free: the bucket index is the count of powers of
+    two at-or-below v (a [.., B-1] compare + reduce keeps the VPU full
+    instead of a serialized floor-log)."""
+    thr = jnp.asarray([1 << b for b in range(num_buckets - 1)], I32)
+    return jnp.sum((v[..., None] >= thr).astype(I32), axis=-1)
+
+
+def log_bucket_counts_masked(v: jnp.ndarray, num_buckets: int,
+                             mask: jnp.ndarray) -> jnp.ndarray:
+    """[B] histogram of `v` (any leading shape) over log2 buckets,
+    restricted to `mask` (same shape as v; masked-out elements count
+    toward no bucket). One-hot compare + reduce — no scatters, so it
+    vectorizes on TPU like the ring/quorum kernels above. The ONE
+    bucketing implementation: the unmasked variant wraps it, so the
+    bucket discipline cannot diverge between the two."""
+    b = log_bucket_index(v, num_buckets)
+    hit = (b[..., None] == jnp.arange(num_buckets, dtype=I32))
+    hit = hit & mask[..., None]
+    axes = tuple(range(hit.ndim - 1))
+    return jnp.sum(hit.astype(I32), axis=axes)
+
+
+def log_bucket_counts(v: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    """Unmasked log_bucket_counts_masked (an all-true mask fuses to a
+    no-op; a shared Optional-mask branch would trip the jitlint
+    tracer-branch rule)."""
+    return log_bucket_counts_masked(
+        v, num_buckets, jnp.ones(jnp.shape(v), bool))
+
+
 def ring_write(
     log_term: jnp.ndarray, start_index: jnp.ndarray, terms: jnp.ndarray,
     count: jnp.ndarray,

@@ -354,6 +354,14 @@ class BatchedRawNode:
         # folds it into the attached hub (hosting layer sets one).
         self.telemetry_hub = None  # TelemetryHub, optional
         self.last_frame: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        # Fleet observatory plane (cfg.fleet_summary): the round also
+        # returns the flat SummaryFrame vector (obs/fleet.FleetLayout);
+        # fetched with the round's other reads — no extra sync — and
+        # folded into the attached FleetHub. Output position after
+        # (state, outbox, aux[, telemetry]).
+        self.fleet_hub = None  # obs.fleet.FleetHub, optional
+        self.last_fleet: Optional[np.ndarray] = None
+        self._fleet_idx = 3 + (1 if self.cfg.telemetry else 0)
         # Proposal-lifecycle tracer (etcd_tpu.obs.Tracer, optional —
         # hosting layer attaches one). Purely host-side: the device
         # program and protocol state are identical with it on or off;
@@ -744,6 +752,13 @@ class BatchedRawNode:
                     tel_counters, tel_inv,
                     extra={"outbox_lanes": lane_summary(
                         np.asarray(outbox.valid))})
+        if cfg.fleet_summary:
+            # Same host gather as the state reads above — the frame is
+            # a round output already on device; no extra sync happens.
+            fleet_vec = np.asarray(step_out[self._fleet_idx])
+            self.last_fleet = fleet_vec
+            if self.fleet_hub is not None:
+                self.fleet_hub.ingest_round(fleet_vec)
         tr_extract = time.monotonic_ns() if tracer is not None else 0
         t1 = time.perf_counter()
         self.phase_last["step"] = t1 - t0

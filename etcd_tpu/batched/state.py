@@ -84,6 +84,16 @@ class BatchedConfig(NamedTuple):
     # never traced); with telemetry=True protocol state is
     # bit-identical (the frame only reads state).
     telemetry: bool = False
+    # Fleet observatory plane (see obs/fleet.py): the round also emits
+    # one flat fixed-shape SummaryFrame — log-bucketed commit-progress/
+    # backlog/inflight histograms, leader/role/progress censuses, term
+    # spread, a bounded groups×time heat strip, and a lax.top_k of the
+    # worst-backlogged rows with identities — aggregated ON DEVICE so
+    # fleet visibility never costs G host-side series. Same contract
+    # as `telemetry`: static, default off, fleet_summary=False compiles
+    # the identical program, fleet_summary=True keeps protocol state
+    # bit-identical (the frame is a pure read of round inputs/outputs).
+    fleet_summary: bool = False
 
     @property
     def num_instances(self) -> int:

@@ -42,11 +42,14 @@ from .kernels import (
     invariant_bits,
     joint_committed,
     joint_vote_result,
+    log_bucket_counts,
+    log_bucket_counts_masked,
     ring_write,
     ring_write_masked,
     term_at,
 )
 from ..analysis.sentinels import note_compile_key
+from ..obs.fleet import FLEET_BUCKETS, FleetLayout
 from .telemetry import NUM_COUNTERS
 from .state import (
     CANDIDATE,
@@ -1227,6 +1230,81 @@ def _telemetry_frame(cfg: BatchedConfig, slot, pre: BatchedState,
     return TelemetryFrame(counters, invariant_bits(post, slot))
 
 
+def _fleet_frame(cfg: BatchedConfig, pre: BatchedState,
+                 post: BatchedState, iids, slots) -> jnp.ndarray:
+    """The fleet SummaryFrame (cfg.fleet_summary): one flat [L] i32
+    vector in obs/fleet.FleetLayout field order, computed OUTSIDE the
+    per-instance vmap — every field is a cross-row reduction
+    (histograms, censuses, heat bins, top-k), aggregated at the source
+    so fleet visibility costs O(L), never O(G), host-side. A pure READ
+    of the round's pre/post state: protocol state stays bit-identical
+    and with fleet_summary=False none of this is ever traced."""
+    n = post.term.shape[0]
+    r = cfg.num_replicas
+    layout = FleetLayout(n, r, cfg.num_groups)
+    peers = jnp.arange(r, dtype=I32)
+
+    delta = post.commit - pre.commit          # [N] commit progress
+    backlog = post.last - post.commit         # [N] uncommitted tail
+    is_leader = post.role == LEADER
+    # Leader-side tracked peers (voters of both halves + learners,
+    # self excluded) — the progress rows the pr/inflight censuses read.
+    tracked = (
+        (post.voter | post.voter_out | post.learner)
+        & (peers[None, :] != slots[:, None])
+    )
+    lmask = is_leader[:, None] & tracked
+
+    group = iids // r                         # [N] group id of each row
+    hb = layout.heat_bins
+    gbin = group * hb // cfg.num_groups       # [N] heat column
+    heat_hit = gbin[:, None] == jnp.arange(hb, dtype=I32)[None, :]
+
+    k = layout.top_k
+    # lax.top_k makes laggards IDENTIFIABLE: the k worst-backlogged
+    # rows with their full identity. The k-element gathers below are
+    # negligible next to the top_k sort itself (k is 8, not G).
+    top_lag, top_idx = jax.lax.top_k(backlog, k)
+
+    parts = {
+        "hist_commit_delta": log_bucket_counts(delta, FLEET_BUCKETS),
+        "hist_backlog": log_bucket_counts(backlog, FLEET_BUCKETS),
+        "hist_inflight": log_bucket_counts_masked(
+            post.inflight, FLEET_BUCKETS, lmask),
+        "leader_slot": jnp.sum(
+            ((slots[:, None] == peers[None, :]) & is_leader[:, None])
+            .astype(I32), axis=0),
+        "role_census": jnp.sum(
+            (post.role[:, None] == jnp.arange(4, dtype=I32)[None, :])
+            .astype(I32), axis=0),
+        "pr_census": jnp.stack([
+            jnp.sum((lmask & (post.pr_state == s)).astype(I32))
+            for s in (PROBE, REPLICATE, SNAPSHOT)]),
+        "fenced": jnp.sum(post.fenced.astype(I32))[None],
+        "term_min": jnp.min(post.term)[None],
+        "term_max": jnp.max(post.term)[None],
+        "term_sum": jnp.sum(post.term)[None],
+        "heat_commit": jnp.sum(
+            heat_hit.astype(I32) * delta[:, None], axis=0),
+        "heat_backlog": jnp.sum(
+            heat_hit.astype(I32) * backlog[:, None], axis=0),
+        "top_group": group[top_idx],
+        "top_lag": top_lag,
+        "top_commit": post.commit[top_idx],
+        "top_applied": post.applied[top_idx],
+        "top_term": post.term[top_idx],
+        "top_role": post.role[top_idx],
+        "top_lead": post.lead[top_idx],
+    }
+    pieces = []
+    for name, length, _acc in layout.fields:
+        p = jnp.ravel(jnp.asarray(parts[name], I32))
+        assert p.shape == (length,), (
+            f"fleet frame field {name}: {p.shape} != ({length},)")
+        pieces.append(p)
+    return jnp.concatenate(pieces)
+
+
 class StepAux(NamedTuple):
     """Per-instance mid-round snapshots the host needs.
 
@@ -1324,11 +1402,23 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
                 propose_n, isolate, transfer_to, read_req,
             )
         sti, out, aux = outs[:3]
+        fleet = None
+        if cfg.fleet_summary:
+            # Cross-row reductions, so this lives OUTSIDE the vmap on
+            # the full [N, ...] pre/post state (`st` is the widened
+            # round-entry state; `sti` the widened post state).
+            with jax.named_scope("raft_fleet"):
+                fleet = _fleet_frame(cfg, st, sti, iids, slots)
         if cfg.narrow_lanes:
             sti = narrow_state(sti)
+        # Output order: (state, outbox[, aux][, telemetry][, fleet]) —
+        # callers index via the cfg flags (engine/rawnode compute the
+        # positions once at build time).
         ret = (sti, out) + ((aux,) if with_aux else ())
         if cfg.telemetry:
             ret += (outs[3],)
+        if cfg.fleet_summary:
+            ret += (fleet,)
         return ret
 
     # NOT donated: hosting callers (BatchedRawNode) build the inbox by

@@ -357,11 +357,15 @@ class TelemetryHub:
             rnd = self._round
             trips = self._trips
         if path is None:
-            os.makedirs(self.dump_dir, exist_ok=True)
-            ts = time.strftime("%Y%m%d-%H%M%S")
-            path = os.path.join(
-                self.dump_dir,
-                f"flightrec_m{self.member}_{ts}_{reason}.json")
+            # Shared collision-free artifact naming (obs.artifacts):
+            # simultaneous multi-member dumps on a checker failure must
+            # never overwrite each other. Lazy import: obs must stay
+            # out of this module's import graph (tracer imports the
+            # registry families from here).
+            from ..obs.artifacts import KIND_FLIGHTREC, dump_path
+
+            path = dump_path(KIND_FLIGHTREC, self.member, reason,
+                             self.dump_dir)
         payload = {
             "member": self.member,
             "reason": reason,

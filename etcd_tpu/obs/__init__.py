@@ -16,11 +16,21 @@ Pieces:
 * ``export`` — Chrome-trace / Perfetto JSON exporter + validator.
 * ``tools/trace_merge.py`` — joins per-member dumps into one timeline
   with cross-process clock-offset estimation from send/recv pairs.
+* ``fleet`` — the fleet observatory (ISSUE 10): layout of the
+  device-side group-state SummaryFrame plus the host FleetHub
+  (``etcd_tpu_fleet_*`` families, groups×time heatmap ring, counted
+  anomaly flags); ``tools/fleet_console.py`` renders a live cluster.
+* ``artifacts`` — the one collision-free ``artifacts/`` naming scheme
+  every observability dump (flightrec/tracering/fleetheat) shares.
 
 Tracing is OFF by default and purely host-side: the jitted round
 program and protocol state are bit-identical with it on or off
-(tests/obs/test_tracing.py pins both).
+(tests/obs/test_tracing.py pins both). The fleet summary is likewise
+OFF by default; it IS device-side, but a pure read — bit-parity is
+pinned the same way (tests/batched/test_fleet.py).
 """
 
 from .tracer import STAGES, Tracer, make_tracer  # noqa: F401
 from .export import chrome_trace, validate_chrome_trace  # noqa: F401
+from .fleet import FleetHub, FleetLayout  # noqa: F401
+from .artifacts import dump_path  # noqa: F401

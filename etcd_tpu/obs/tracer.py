@@ -225,11 +225,14 @@ class Tracer:
         """Write the span ring as JSON next to the flight recorders;
         returns the path."""
         if path is None:
-            os.makedirs(self.dump_dir, exist_ok=True)
-            ts = time.strftime("%Y%m%d-%H%M%S")
-            path = os.path.join(
-                self.dump_dir,
-                f"tracering_m{self.member}_{ts}_{reason}.json")
+            # Shared collision-free artifact naming (obs.artifacts):
+            # keyed by kind+member, made unique by pid + sequence so
+            # simultaneous multi-member (or same-second re-)dumps
+            # never overwrite each other.
+            from .artifacts import KIND_TRACERING, dump_path
+
+            path = dump_path(KIND_TRACERING, self.member, reason,
+                             self.dump_dir)
         payload = self.to_payload()
         payload["reason"] = reason
         payload["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")

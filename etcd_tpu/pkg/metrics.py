@@ -165,14 +165,23 @@ class Histogram(_Metric):
         return Histogram(self.name, self.help, buckets=self.buckets)
 
     def observe(self, v: float) -> None:
+        self.observe_many(v, 1)
+
+    def observe_many(self, v: float, n: int) -> None:
+        """Fold `n` observations of value `v` in one lock acquisition —
+        the pre-bucketed ingest path for device-side histograms (the
+        fleet summary frame arrives as bucket counts, not samples;
+        calling observe() count-times would be O(rows) per frame)."""
+        if n <= 0:
+            return
         with self._lock:
-            self._sum += v
-            self._count += 1
+            self._sum += v * n
+            self._count += n
             for i, b in enumerate(self.buckets):
                 if v <= b:
-                    self._counts[i] += 1
+                    self._counts[i] += n
                     return
-            self._counts[-1] += 1
+            self._counts[-1] += n
 
     def time(self):
         return _Timer(self)

@@ -18,7 +18,8 @@ from typing import Tuple
 
 def make_bench_engine(groups: int, lanes_minor: bool = True,
                       merged_deliver: bool = False,
-                      telemetry: bool = False):
+                      telemetry: bool = False,
+                      fleet: bool = False):
     """Build the canonical bench engine (BENCH_r05 methodology: R=3,
     W=32, E=4, steady state with no timer elections, auto-compacting
     ring), elect every group's slot-0 replica, and return the engine
@@ -27,7 +28,8 @@ def make_bench_engine(groups: int, lanes_minor: bool = True,
     ``telemetry`` compiles the kernel telemetry plane in (ISSUE 4):
     the headline number stays telemetry-off; BENCH_TELEMETRY=1 /
     frontier --telemetry measure the overhead so it stays pinned in
-    BENCH_NOTES."""
+    BENCH_NOTES. ``fleet`` likewise compiles the fleet-summary plane
+    in (ISSUE 10; BENCH_FLEET=1 / tools/fleet_overhead.py)."""
     import jax.numpy as jnp
 
     from ..batched import BatchedConfig, MultiRaftEngine
@@ -44,6 +46,7 @@ def make_bench_engine(groups: int, lanes_minor: bool = True,
         lanes_minor=lanes_minor,
         merged_deliver=merged_deliver,
         telemetry=telemetry,
+        fleet_summary=fleet,
     )
     eng = MultiRaftEngine(cfg)
     eng.campaign([g * cfg.num_replicas for g in range(groups)])

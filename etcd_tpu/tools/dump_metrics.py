@@ -4,8 +4,9 @@ member and print its metric names/values sorted).
 Three sources: an HTTP /metrics endpoint (--addr), a batched hosting
 member's admin port (--admin, the line-JSON 'metrics' op serving the
 same Prometheus text — kernel telemetry counters, invariant trips,
-WAL fsync / round-phase histograms, router loss classes), or the local
-registry (default: every metric this build registers).
+WAL fsync / round-phase histograms, router loss classes, and the
+etcd_tpu_fleet_* observatory families when the member runs --fleet),
+or the local registry (default: every metric this build registers).
 
 ``--watch N`` re-scrapes every N seconds and prints per-interval
 deltas and rates for every series that moved — eyeball a live hosted
@@ -132,6 +133,7 @@ def dump_local(names_only: bool = False) -> int:
     import etcd_tpu.storage.mvcc.metrics  # noqa: F401
     import etcd_tpu.transport.metrics  # noqa: F401
     from etcd_tpu.batched import telemetry as btel
+    from etcd_tpu.obs import fleet as bfleet
     from etcd_tpu.pkg import metrics as pmet
 
     for name in btel.TM_NAMES:
@@ -141,6 +143,10 @@ def dump_local(names_only: bool = False) -> int:
     btel.round_phase_histogram()
     btel.router_loss_counter()
     btel.fenced_groups_gauge()
+    # Fleet observatory families (ISSUE 10): histograms + censuses +
+    # anomaly counters fed from the device SummaryFrame; --watch picks
+    # their deltas up like any other series once a member moves them.
+    bfleet.register_families()
     for line in pmet.DEFAULT.expose().splitlines():
         if line.startswith("#"):
             continue

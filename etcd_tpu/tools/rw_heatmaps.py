@@ -1,4 +1,4 @@
-"""Read/write performance heatmap data generator
+"""Client-side read/write grid heatmap data generator
 (ref: tools/rw-heatmaps — sweeps value size × R/W ratio and emits CSV
 for the heatmap plot script).
 
@@ -11,6 +11,15 @@ and writes one CSV row per cell:
 The reference drives `benchmark mixed` over the same grid and plots
 with rw-heatmaps/plot_data.py; the CSV schema here matches what that
 plotting flow consumes.
+
+Cluster-SIDE heat (per-group commit progress / backlog over time) now
+comes from the fleet observatory instead (ISSUE 10): members run with
+``fleet_summary`` on, the device summarizes every round, and
+``obs.fleet.FleetHub`` dumps a bounded groups×time ``fleetheat_*``
+artifact — see ``tools/fleet_console.py``. This tool remains the
+client-facing grid sweep; its default output lands under the same
+``artifacts/`` naming scheme so grid CSVs and fleet heat dumps live
+side by side.
 """
 
 from __future__ import annotations
@@ -80,7 +89,9 @@ def run_cell(endpoints, value_size: int, read_ratio: float, clients: int,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="rw-heatmaps")
     p.add_argument("--endpoints", default="127.0.0.1:2379")
-    p.add_argument("--out", default="rw_heatmap.csv")
+    p.add_argument("--out", default="",
+                   help="output CSV (default: a timestamped "
+                        "artifacts/rwgrid_* path via obs.artifacts)")
     p.add_argument("--clients", type=int, default=8)
     p.add_argument("--duration", type=float, default=2.0,
                    help="seconds per grid cell")
@@ -91,6 +102,10 @@ def main(argv=None) -> int:
     endpoints = _parse_endpoints(args.endpoints)
     sizes = [int(x) for x in args.value_sizes.split(",")]
     ratios = [float(x) for x in args.read_ratios.split(",")]
+    if not args.out:
+        from ..obs.artifacts import KIND_RWGRID, dump_path
+
+        args.out = dump_path(KIND_RWGRID, "client", "grid", ext="csv")
 
     with open(args.out, "w", newline="") as f:
         w = csv.writer(f)

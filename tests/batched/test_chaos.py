@@ -39,6 +39,11 @@ CFG = BatchedConfig(
     num_groups=G, num_replicas=R, window=16, max_ents_per_msg=4,
     max_props_per_round=4, election_timeout=10, heartbeat_timeout=1,
     pre_vote=True, check_quorum=True, auto_compact=True,
+    # Fleet observatory on (ISSUE 10): every quick chaos episode now
+    # proves the device summary is a pure observer under faults —
+    # strict checkers with the plane compiled in. Still ONE config
+    # (test_torn_fence/test_tracing share it value-identically).
+    fleet_summary=True,
 )
 
 SEEDS = tuple(

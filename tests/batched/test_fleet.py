@@ -1,0 +1,435 @@
+"""Fleet observatory (ISSUE 10): bit-parity, shadow-oracle
+reconciliation of the device SummaryFrame (histograms, heat strip,
+top-K laggards), FleetHub folding/anomaly flags, and a chaos episode
+with the plane on.
+
+Compile discipline: CFG_OFF is value-identical to test_telemetry's
+telemetry-off config (zero new round-step programs); CFG_ON differs
+only in fleet_summary=True — the suite's ONE new compile, reviewed in
+tests/batched/conftest.py's ROUND_STEP_SHAPE_BUDGET comment. The
+chaos episode is slow-marked (it uses the harness default config, the
+soak suite's shape).
+"""
+
+import glob
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
+from etcd_tpu.batched.shadow import ShadowCluster
+from etcd_tpu.batched.state import LEADER
+from etcd_tpu.obs.fleet import (
+    BUCKET_BOUNDS,
+    FLEET_BUCKETS,
+    FleetHub,
+    FleetLayout,
+)
+from etcd_tpu.pkg import metrics as pmet
+
+G, R = 2, 3
+ET = 1 << 20  # no timer elections: deterministic schedules
+
+
+def make_cfg(fleet):
+    return BatchedConfig(
+        num_groups=G, num_replicas=R, window=32,
+        max_ents_per_msg=4, max_props_per_round=4,
+        election_timeout=ET, heartbeat_timeout=1,
+        fleet_summary=fleet,
+    )
+
+
+CFG_OFF = make_cfg(False)  # == test_telemetry CFG_OFF: shared compile
+CFG_ON = make_cfg(True)
+
+
+def np_bucket(v: int) -> int:
+    """Host mirror of kernels.log_bucket_index."""
+    return sum(1 for b in BUCKET_BOUNDS[1:] if v >= b)
+
+
+def drive(eng, pipelined):
+    """The test_telemetry schedule — the same input stream for on/off
+    engines; the pipelined variant reuses the serial scan program."""
+    n = eng.cfg.num_instances
+    eng.campaign([i * R for i in range(G)])
+    for _ in range(3):
+        eng.step_round()
+    props = jnp.zeros((n,), jnp.int32)
+    props = props.at[jnp.arange(G) * R].set(2)
+    eng.step_round(propose_n=props)
+    eng.read_index([0])
+    if pipelined:
+        eng.run_rounds_pipelined(12, chunk=12, tick=True,
+                                 propose_n=props)
+    else:
+        eng.run_rounds(12, tick=True, propose_n=props)
+    eng.step_round(tick=True)
+
+
+def test_protocol_state_bit_identical_on_off():
+    """Acceptance: fleet_summary=True must not change a single bit of
+    protocol state (or the routed inbox — the Ready stream's source)
+    vs fleet_summary=False, serial and pipelined."""
+    a = MultiRaftEngine(CFG_OFF)
+    b = MultiRaftEngine(CFG_ON)
+
+    def compare(loop):
+        for field in a.state._fields:
+            av = np.asarray(getattr(a.state, field))
+            bv = np.asarray(getattr(b.state, field))
+            assert np.array_equal(av, bv), (
+                f"state field {field} diverged with fleet on ({loop})")
+        for field in a.inbox._fields:
+            av = np.asarray(getattr(a.inbox, field))
+            bv = np.asarray(getattr(b.inbox, field))
+            assert np.array_equal(av, bv), (
+                f"inbox field {field} diverged ({loop})")
+
+    drive(a, False)
+    drive(b, False)
+    compare("serial")
+    drive(a, True)
+    drive(b, True)
+    compare("pipelined")
+
+
+def test_summary_reconciles_with_shadow_oracle(tmp_path):
+    """Acceptance: the device summary's histograms, heat strip and
+    top-K laggard identities must match ground truth recomputed from
+    the shadow oracle's per-group state, on a seeded skewed workload
+    (two groups starved of quorum for different spans, so their
+    leaders' backlogs differ and the top-K ordering is exact)."""
+    eng = MultiRaftEngine(CFG_ON)
+    shadows = [ShadowCluster(R, election_timeout=ET,
+                             heartbeat_timeout=1) for _ in range(G)]
+    n = eng.cfg.num_instances
+    lay = FleetLayout(n, R, G)
+
+    # Expected cumulative commit-delta histogram / heat, tracked in
+    # lockstep round by round (the device accumulates per-round).
+    exp_delta_hist = np.zeros(FLEET_BUCKETS, np.int64)
+    exp_heat_commit = np.zeros(G, np.int64)
+    prev_commit = np.zeros(n, np.int64)
+
+    def oracle_commit():
+        return np.array([
+            shadows[i // R].nodes[i % R].raft.raft_log.committed
+            for i in range(n)], np.int64)
+
+    def round_(campaign=(), props=None, isolate=()):
+        """One lockstep round. campaign/props keyed by (group, slot);
+        isolate is a set of (group, slot) rows cut off the network on
+        BOTH sides of the differential."""
+        camp = np.zeros(n, bool)
+        pr = np.zeros(n, np.int32)
+        iso = np.zeros(n, bool)
+        for (g, s) in campaign:
+            camp[g * R + s] = True
+        for (g, s), k in (props or {}).items():
+            pr[g * R + s] = k
+        for (g, s) in isolate:
+            iso[g * R + s] = True
+        eng.step_round(campaign_mask=jnp.asarray(camp),
+                       propose_n=jnp.asarray(pr),
+                       isolate=jnp.asarray(iso))
+        for gi, shadow in enumerate(shadows):
+            shadow.round(
+                campaigns=[s for (g2, s) in campaign if g2 == gi],
+                proposals={s: k for (g2, s), k in (props or {}).items()
+                           if g2 == gi},
+                isolate=[s for (g2, s) in isolate if g2 == gi],
+            )
+        # Fold this round's oracle commit deltas into the expectation.
+        nonlocal prev_commit
+        cur = oracle_commit()
+        delta = cur - prev_commit
+        prev_commit = cur
+        for i in range(n):
+            exp_delta_hist[np_bucket(int(delta[i]))] += 1
+            exp_heat_commit[i // R] += int(delta[i])
+
+    # Elect g0/slot0 and g1/slot2; let empty entries commit.
+    round_(campaign=((0, 0), (1, 2)))
+    for _ in range(4):
+        round_()
+    # Healthy commits on both groups.
+    round_(props={(0, 0): 2, (1, 2): 2})
+    for _ in range(3):
+        round_()
+    # Skew: starve group 1 of quorum for 4 proposal rounds (both
+    # followers isolated), group 0 for 2 — backlogs 4 vs 2.
+    iso_g1 = {(1, 0), (1, 1)}
+    iso_g0 = {(0, 1), (0, 2)}
+    round_(props={(1, 2): 1}, isolate=iso_g1)
+    round_(props={(1, 2): 1}, isolate=iso_g1)
+    round_(props={(1, 2): 1, (0, 0): 1}, isolate=iso_g1 | iso_g0)
+    round_(props={(1, 2): 1, (0, 0): 1}, isolate=iso_g1 | iso_g0)
+
+    f = lay.decode(eng.fleet_frame())
+
+    # Oracle ground truth for the final round's snapshot fields.
+    o_commit = oracle_commit()
+    o_last = np.array([
+        shadows[i // R].nodes[i % R].raft.raft_log.last_index()
+        for i in range(n)], np.int64)
+    o_term = np.array([
+        shadows[i // R].nodes[i % R].raft.term
+        for i in range(n)], np.int64)
+    o_role = np.array([
+        int(shadows[i // R].nodes[i % R].raft.state)
+        for i in range(n)], np.int64)
+    o_backlog = o_last - o_commit
+
+    # Backlogs came out as designed: 4 on g1's leader, 2 on g0's.
+    assert o_backlog[1 * R + 2] == 4 and o_backlog[0 * R + 0] == 2, (
+        o_backlog)
+
+    # Histograms.
+    assert f["hist_commit_delta"].tolist() == exp_delta_hist.tolist()
+    exp_backlog_hist = np.zeros(FLEET_BUCKETS, np.int64)
+    for v in o_backlog:
+        exp_backlog_hist[np_bucket(int(v))] += 1
+    assert f["hist_backlog"].tolist() == exp_backlog_hist.tolist()
+
+    # Heat strip (G=2 -> one column per group).
+    assert f["heat_commit"].tolist() == exp_heat_commit.tolist()
+    exp_heat_backlog = [int(o_backlog[g * R:(g + 1) * R].sum())
+                        for g in range(G)]
+    assert f["heat_backlog"].tolist() == exp_heat_backlog
+
+    # Censuses.
+    exp_leader_slot = [
+        int(sum(1 for i in range(n)
+                if o_role[i] == LEADER and i % R == s))
+        for s in range(R)]
+    assert f["leader_slot"].tolist() == exp_leader_slot == [1, 0, 1]
+    assert f["role_census"].tolist() == [
+        int((o_role == r).sum()) for r in range(4)]
+    assert int(f["fenced"][0]) == 0
+    assert int(f["term_min"][0]) == int(o_term.min())
+    assert int(f["term_max"][0]) == int(o_term.max())
+    assert int(f["term_sum"][0]) == int(o_term.sum())
+
+    # Top-K laggard identity: rows sorted by backlog descending; the
+    # two positive-lag rows are exactly the two starved leaders, in
+    # order, with their full oracle identity.
+    order = sorted(range(n), key=lambda i: (-int(o_backlog[i]), i))
+    exp_rows = [i for i in order if o_backlog[i] > 0]
+    got = [(int(f["top_group"][j]), int(f["top_lag"][j]),
+            int(f["top_commit"][j]), int(f["top_applied"][j]),
+            int(f["top_term"][j]), int(f["top_role"][j]))
+           for j in range(len(exp_rows))]
+    want = [(i // R, int(o_backlog[i]), int(o_commit[i]),
+             int(o_commit[i]),  # device applies at commit
+             int(o_term[i]), int(o_role[i])) for i in exp_rows]
+    assert got == want, (got, want)
+    assert [g for g, *_ in got] == [1, 0]
+    # Padding entries beyond the laggards carry no positive lag.
+    for j in range(len(exp_rows), lay.top_k):
+        assert int(f["top_lag"][j]) <= 0
+
+    # Hub fold of the engine accumulator: snapshot survives the trip,
+    # registry families move, heat dump lands under the shared naming.
+    reg = pmet.Registry()
+    hub = FleetHub(n, R, G, member="7", registry=reg,
+                   dump_dir=str(tmp_path))
+    eng.fleet_hub = hub
+    eng.drain_fleet()
+    snap = hub.snapshot()
+    assert snap["leaders_total"] == 2
+    assert [e["group"] for e in snap["top"]] == [1, 0]
+    assert snap["top"][0]["lag"] == 4 and snap["top"][0]["role"] == (
+        "leader")
+    text = reg.expose()
+    assert 'etcd_tpu_fleet_leader_groups{member="7",slot="0"} 1' in text
+    assert 'etcd_tpu_fleet_frames_total{member="7"} 1' in text
+    assert "etcd_tpu_fleet_commit_delta_bucket" in text
+    p = hub.dump(reason="unit")
+    assert os.path.basename(p).startswith("fleetheat_m7_")
+    assert glob.glob(str(tmp_path / "fleetheat_m7_*_unit.json")) == [p]
+
+    # Drain banks the device window's sums into the i64 host base and
+    # resets them on device (the i32-wrap guard): the public monotone
+    # totals are unchanged by the drain, and a second drain with no
+    # new rounds folds a zero delta (registry histograms unmoved).
+    total_before = eng.fleet_frame()
+    assert np.array_equal(
+        total_before[lay.offsets["hist_commit_delta"][0]:
+                     lay.offsets["hist_commit_delta"][1]],
+        exp_delta_hist)
+    delta_lines = lambda t: sorted(  # noqa: E731
+        ln for ln in t.splitlines() if "commit_delta_bucket" in ln)
+    before = delta_lines(reg.expose())
+    eng.drain_fleet()  # second drain, no rounds in between
+    assert np.array_equal(eng.fleet_frame(), total_before)
+    assert delta_lines(reg.expose()) == before  # zero delta folded
+    assert hub.frames() == 2
+    # Device-side window really was reset to zero on the sum fields.
+    s, e = lay.offsets["hist_commit_delta"]
+    assert np.asarray(eng._fleet_vec)[s:e].sum() == 0
+
+
+# -----------------------------------------------------------------------------
+# Host-side hub semantics on synthetic frames (no device, no compile).
+# -----------------------------------------------------------------------------
+
+
+def make_vec(lay, **fields):
+    vec = np.zeros(lay.size, np.int64)
+    for name, vals in fields.items():
+        s, e = lay.offsets[name]
+        arr = np.asarray(vals, np.int64).ravel()
+        vec[s:s + len(arr)] = arr
+    return vec
+
+
+def test_layout_bin_starts_mirror_device_mapping():
+    """The host labeling of heat columns must match the device's
+    ``bin = g * hb // G`` exactly, including non-divisible G where the
+    bins are non-uniform (a ceil-stride label would misattribute)."""
+    for g_total in (200, 128, 130, 8, 4096):
+        lay = FleetLayout(g_total, 3, g_total)
+        starts = lay.bin_starts()
+        assert starts[0] == 0 and starts[-1] == g_total
+        assert starts == sorted(starts)
+        for g in range(g_total):
+            col = g * lay.heat_bins // g_total
+            assert starts[col] <= g < starts[col + 1], (
+                g_total, g, col, starts[col:col + 2])
+
+
+def test_hub_commit_frozen_anomaly():
+    """A top-K laggard whose commit is pinned while a leader exists
+    must raise commit_frozen exactly once at freeze_frames, and re-arm
+    after the group moves again."""
+    lay = FleetLayout(32, 3, 32)
+    reg = pmet.Registry()
+    hub = FleetHub(32, 3, 32, member="1", registry=reg,
+                   freeze_frames=3)
+    frozen = make_vec(lay, top_group=[5], top_lag=[7],
+                      top_commit=[40], top_lead=[2])
+    for _ in range(2):
+        hub.ingest_round(frozen)
+    assert hub.anomalies() == {}
+    hub.ingest_round(frozen)  # third consecutive frame -> flag
+    assert hub.anomalies() == {"commit_frozen": 1}
+    hub.ingest_round(frozen)  # still frozen: counted once, not again
+    assert hub.anomalies() == {"commit_frozen": 1}
+    moved = make_vec(lay, top_group=[5], top_lag=[7],
+                     top_commit=[41], top_lead=[2])
+    hub.ingest_round(moved)  # progress re-arms the detector
+    for _ in range(3):
+        hub.ingest_round(make_vec(lay, top_group=[5], top_lag=[7],
+                                  top_commit=[41], top_lead=[2]))
+    assert hub.anomalies() == {"commit_frozen": 2}
+    ev = [e for e in hub.anomaly_log() if e["kind"] == "commit_frozen"]
+    assert ev and ev[0]["group"] == 5
+    assert ('etcd_tpu_fleet_anomalies_total'
+            '{member="1",kind="commit_frozen"} 2') in reg.expose()
+    # A leaderless laggard (lead=0, not the leader itself) never flags:
+    # lag without a leader is expected, not anomalous.
+    hub2 = FleetHub(32, 3, 32, member="2", registry=reg,
+                    freeze_frames=2)
+    dark = make_vec(lay, top_group=[4], top_lag=[9], top_commit=[10])
+    for _ in range(5):
+        hub2.ingest_round(dark)
+    assert hub2.anomalies() == {}
+
+
+def test_hub_leader_skew_anomaly_edge_triggered():
+    lay = FleetLayout(60, 3, 60)
+    reg = pmet.Registry()
+    hub = FleetHub(60, 3, 60, member="3", registry=reg,
+                   skew_ratio=2.0)
+    fair = make_vec(lay, leader_slot=[20, 20, 20])
+    skew = make_vec(lay, leader_slot=[55, 3, 2])  # 55 / (60/3) = 2.75
+    hub.ingest_round(fair)
+    assert hub.anomalies() == {}
+    hub.ingest_round(skew)
+    assert hub.anomalies() == {"leader_skew": 1}
+    hub.ingest_round(skew)  # level-hold: no re-count while skewed
+    assert hub.anomalies() == {"leader_skew": 1}
+    hub.ingest_round(fair)  # heal re-arms
+    hub.ingest_round(skew)
+    assert hub.anomalies() == {"leader_skew": 2}
+    assert 'etcd_tpu_fleet_leader_skew_ratio{member="3"} 2750' in (
+        reg.expose())
+
+
+def test_hub_totals_delta_fold_and_ring_bound(tmp_path):
+    """ingest_totals folds ACC_SUM fields as deltas against the prior
+    drain (the engine's accumulator is monotone) while snapshots pass
+    through; the heat ring stays bounded."""
+    lay = FleetLayout(8, 3, 8)
+    reg = pmet.Registry()
+    hub = FleetHub(8, 3, 8, member="4", registry=reg, ring=3,
+                   dump_dir=str(tmp_path))
+    t1 = make_vec(lay, hist_commit_delta=[0, 10], heat_commit=[5, 5],
+                  hist_backlog=[8], leader_slot=[8, 0, 0])
+    hub.ingest_totals(t1)
+    t2 = make_vec(lay, hist_commit_delta=[0, 16], heat_commit=[9, 6],
+                  hist_backlog=[8], leader_slot=[8, 0, 0])
+    hub.ingest_totals(t2)
+    recs = hub.records()
+    # Second fold carries only the delta on sum fields...
+    assert recs[-1]["heat_commit"][:2] == [4, 1]
+    # ...and the raw snapshot on last fields.
+    assert recs[-1]["leader_slot"] == [8, 0, 0]
+    # delta histogram counter: 10 + 6 observations at bucket 1.
+    assert ('etcd_tpu_fleet_commit_delta_bucket'
+            '{member="4",le="1"} 16') in reg.expose()
+    for _ in range(5):
+        hub.ingest_totals(t2)
+    assert len(hub.records()) == 3  # bounded ring
+
+
+# -----------------------------------------------------------------------------
+# Chaos: the observatory must be a pure observer under faults.
+# -----------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_episode_with_fleet_strict(tmp_path, monkeypatch):
+    """A message-fault episode with the full observability stack on
+    (harness default config: telemetry + fleet) closes the strict
+    3-checker bar with invariant_trips()==0, every member folding
+    summary frames, and the checker-failure dump path covering fleet
+    heatmaps."""
+    from etcd_tpu.batched.faults import (
+        ChaosHarness,
+        FaultSpec,
+        LeaderObserver,
+        run_invariant_checks,
+    )
+
+    # Dumps (explicit below, or on a checker failure) land in the
+    # test's tmp dir, not the repo's artifacts/.
+    monkeypatch.setenv("ETCD_TPU_FLIGHTREC_DIR", str(tmp_path))
+    h = ChaosHarness(
+        str(tmp_path), seed=311,
+        spec=FaultSpec(drop=0.05, dup=0.05, delay=0.08,
+                       delay_max_s=0.04, reorder=0.2),
+        num_members=3, num_groups=8)
+    obs = LeaderObserver(h.alive)
+    try:
+        h.wait_leaders()
+        obs.start()
+        acked = h.run_workload(20)
+        assert acked >= 10, f"only {acked}/20 writes acked"
+        h.plan.quiesce()
+        run_invariant_checks(h, obs, expect_members=3)
+        for m in h.members.values():
+            assert m.fleet is not None and m.fleet.frames() > 0
+            snap = m.fleet.snapshot()
+            assert snap["groups"] == 8 and snap["ring_len"] > 0
+        paths = h.dump_flight_recorders(reason="fleet-test")
+        kinds = {os.path.basename(p).split("_")[0] for p in paths}
+        assert {"flightrec", "fleetheat"} <= kinds, paths
+    finally:
+        obs.stop()
+        h.stop()

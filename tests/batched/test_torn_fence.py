@@ -48,6 +48,7 @@ CFG = BatchedConfig(
     num_groups=G, num_replicas=R, window=16, max_ents_per_msg=4,
     max_props_per_round=4, election_timeout=10, heartbeat_timeout=1,
     pre_vote=True, check_quorum=True, auto_compact=True,
+    fleet_summary=True,  # keep value-identical to test_chaos.CFG
 )
 
 

@@ -124,6 +124,19 @@ def collect(repo: str) -> List[Dict]:
                        "skipped": d.get("skipped"),
                        "n_devices": d.get("n_devices")})
 
+    # Observability overhead rows: fleet-summary A/B cells
+    # (tools/fleet_overhead.py — interleaved on/off, one per G).
+    path = os.path.join(repo, "artifacts", "fleet_overhead.json")
+    d = _load(path) if os.path.exists(path) else None
+    if d:
+        for c in d.get("cells", ()):
+            add("overhead_fleet", path, c.get("overhead_pct"),
+                "% (off->on, interleaved)",
+                config=f"G={c.get('groups')} ({d.get('platform', '')})",
+                captured_at=d.get("captured_at", ""),
+                extra={"off_median": c.get("off_median"),
+                       "on_median": c.get("on_median")})
+
     rows.sort(key=lambda r: (r["kind"], r["round"] or 0, r["source"]))
     return rows
 
@@ -165,6 +178,17 @@ def markdown(rows: List[Dict]) -> str:
                 "| round | source | status |", "|---|---|---|"]
         for r in mc:
             out.append(f"| {r['round']} | {r['source']} | {fmt_val(r)} |")
+        out.append("")
+    ov = [r for r in rows if r["kind"].startswith("overhead_")]
+    if ov:
+        out += ["## Observability overhead (interleaved A/B)", "",
+                "| source | overhead % | off | on | config | captured |",
+                "|---|---|---|---|---|---|"]
+        for r in ov:
+            out.append(
+                f"| {r['source']} | {fmt_val(r)} | {r.get('off_median')} "
+                f"| {r.get('on_median')} | {r['config']} "
+                f"| {r['captured_at']} |")
         out.append("")
     return "\n".join(out) + "\n"
 

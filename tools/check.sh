@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
-# One-shot static gate (ISSUE 7, grown by ISSUE 9): ruff + jitlint +
-# runtime-sentinel smoke (transfer guard, recompile budget, lock
+# One-shot static gate (ISSUE 7, grown by ISSUEs 9/10): ruff + jitlint
+# + runtime-sentinel smoke (transfer guard, recompile budget, lock
 # order) + trace smoke (one traced in-proc round, exporter validated)
-# + bench-history re-emit. CI runs exactly this script
+# + fleet smoke (tiny in-proc cluster with the fleet observatory on,
+# fleet_console --once --json validated) + bench-history re-emit. CI
+# runs exactly this script
 # (.github/workflows/lint.yml); run it locally before pushing anything
 # that touches the batched hot path.
 set -euo pipefail
@@ -26,6 +28,9 @@ python -m pytest tests/analysis tests/batched/test_sentinels.py -q
 
 echo "== trace smoke (one traced in-proc round, exporter validates) =="
 python tools/trace_smoke.py
+
+echo "== fleet smoke (in-proc cluster with fleet on, console --once --json) =="
+python tools/fleet_smoke.py
 
 echo "== bench history (artifacts/bench_history.json + BENCH_HISTORY.md) =="
 python tools/bench_history.py

@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Live cluster console over the fleet observatory (ISSUE 10).
+
+Scrapes the hosting admin endpoints (``fleet``, ``health``, ``stats``
+ops) of every member of a live multi-raft cluster and renders a
+refreshing terminal rollup: leader balance per member, the cluster-wide
+top-K laggards with group ids, fenced-group counts, on-device invariant
+trips, router loss, and the fleet anomaly flags (commit_frozen /
+leader_skew — the signal the ROADMAP item 5 rebalancer consumes).
+
+    python tools/fleet_console.py --admin 127.0.0.1:8001 \
+        --admin 127.0.0.1:8002 --admin 127.0.0.1:8003
+
+``--once --json`` emits one machine-readable cluster rollup and exits —
+the scripting/CI mode (tools/check.sh's fleet smoke and the proc e2e
+test both validate it via :func:`validate_rollup`).
+
+Members must be started with ``--fleet`` (and ideally ``--telemetry``
+for invariant trips); a member with the plane off is reported as
+``err`` rather than silently dropped from the view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _hp(s: str) -> Tuple[str, int]:
+    h, _, p = s.rpartition(":")
+    return h, int(p)
+
+
+def _call(addr: Tuple[str, int], timeout: float, **req) -> Dict:
+    """One line-JSON admin round trip (fresh connection per call: the
+    console is a scraper, not a client — members crash and restart
+    under it and a stale socket must not wedge the refresh loop)."""
+    with socket.create_connection(addr, timeout=timeout) as s:
+        f = s.makefile("rwb")
+        f.write(json.dumps(req).encode() + b"\n")
+        f.flush()
+        line = f.readline()
+    if not line:
+        raise ConnectionError("admin connection closed")
+    return json.loads(line)
+
+
+def _sum_numeric(obj) -> int:
+    """Total of every numeric leaf (router loss dicts differ in shape
+    between the in-proc and TCP fabrics; the rollup wants one number)."""
+    if isinstance(obj, bool):
+        return 0
+    if isinstance(obj, (int, float)):
+        return int(obj)
+    if isinstance(obj, dict):
+        return sum(_sum_numeric(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_sum_numeric(v) for v in obj)
+    return 0
+
+
+def collect(addrs: List[str], timeout: float = 10.0,
+            top: int = 8) -> Dict:
+    """Scrape every member once and build the cluster rollup."""
+    members: Dict[str, Dict] = {}
+    for spec in addrs:
+        addr = _hp(spec)
+        ent: Dict = {"addr": spec}
+        try:
+            fl = _call(addr, timeout, op="fleet")
+            hl = _call(addr, timeout, op="health")
+            st = _call(addr, timeout, op="stats")
+        except (OSError, ConnectionError, ValueError) as e:
+            ent["err"] = f"{type(e).__name__}: {e}"
+            members[spec] = ent
+            continue
+        if not fl.get("ok"):
+            ent["err"] = fl.get("err", "fleet op failed")
+            members[spec] = ent
+            continue
+        roll = fl["rollup"]
+        mid = str(roll.get("member", spec))
+        ent.update({
+            "member": mid,
+            "frames": roll.get("frames", 0),
+            "groups": roll.get("groups"),
+            "leaders": roll.get("leaders_total", 0),
+            "leader_slot": roll.get("leader_slot", []),
+            "fenced": roll.get("fenced", 0),
+            "lag_max": roll.get("lag_max", 0),
+            "role_census": roll.get("role_census", {}),
+            "top": [dict(e2, member=mid)
+                    for e2 in roll.get("top", [])],
+            "anomalies": roll.get("anomalies", {}),
+            "invariant_trips": fl.get("invariant_trips"),
+            "wal_tail": hl.get("wal_tail") if hl.get("ok") else None,
+            "health_fenced": (len(hl.get("fenced_groups", []))
+                              if hl.get("ok") else None),
+            "router_loss": (_sum_numeric(st.get("router", {}))
+                            if st.get("ok") else None),
+        })
+        members[mid] = ent
+
+    live = [m for m in members.values() if "err" not in m]
+    merged_top = sorted(
+        (e for m in live for e in m["top"]),
+        key=lambda e: (-e["lag"], e["group"]))[:top]
+    anomalies: Dict[str, int] = {}
+    for m in live:
+        for k, v in m.get("anomalies", {}).items():
+            anomalies[k] = anomalies.get(k, 0) + int(v)
+    # Unmeasured must stay distinguishable from verified-clean: a
+    # member without --telemetry reports invariant_trips=None, and
+    # summing `or 0` would print "0 trips" for a cluster where trips
+    # were never measured. None propagates when NO member measured.
+    trip_vals = [m["invariant_trips"] for m in live
+                 if m["invariant_trips"] is not None]
+    cluster = {
+        "members_live": len(live),
+        "members_total": len(members),
+        "groups": max((m.get("groups") or 0 for m in live), default=0),
+        "leader_balance": {m["member"]: m["leaders"] for m in live},
+        "leaders_total": sum(m["leaders"] for m in live),
+        "fenced_total": sum(m["fenced"] for m in live),
+        "invariant_trips_total": (sum(trip_vals) if trip_vals
+                                  else None),
+        "router_loss_total": sum(m["router_loss"] or 0 for m in live),
+        "lag_max": max((m["lag_max"] for m in live), default=0),
+        "top": merged_top,
+        "anomalies": anomalies,
+    }
+    return {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "members": members, "cluster": cluster}
+
+
+def validate_rollup(data: Dict) -> List[str]:
+    """Schema check for the --once --json contract (used by the
+    check.sh fleet smoke and the proc e2e test); returns problems,
+    empty == valid."""
+    probs: List[str] = []
+    for key in ("ts", "members", "cluster"):
+        if key not in data:
+            probs.append(f"missing key {key!r}")
+    cl = data.get("cluster", {})
+    for key in ("members_live", "leader_balance", "leaders_total",
+                "fenced_total", "top", "anomalies",
+                "invariant_trips_total", "router_loss_total"):
+        if key not in cl:
+            probs.append(f"cluster missing {key!r}")
+    for e in cl.get("top", ()):
+        for key in ("group", "lag", "commit", "term", "role", "member"):
+            if key not in e:
+                probs.append(f"top entry missing {key!r}: {e}")
+    for mid, m in data.get("members", {}).items():
+        if "err" in m:
+            continue
+        for key in ("member", "frames", "leaders", "top"):
+            if key not in m:
+                probs.append(f"member {mid} missing {key!r}")
+    return probs
+
+
+# -- rendering -----------------------------------------------------------------
+
+
+def render(data: Dict, top: int = 8) -> str:
+    cl = data["cluster"]
+    lines = [
+        f"fleet console @ {data['ts']}  "
+        f"members {cl['members_live']}/{cl['members_total']}  "
+        f"groups {cl['groups']}  leaders {cl['leaders_total']}  "
+        f"fenced {cl['fenced_total']}  "
+        f"inv-trips "
+        f"{'n/a' if cl['invariant_trips_total'] is None else cl['invariant_trips_total']}  "
+        f"router-loss {cl['router_loss_total']}",
+        "",
+        f"{'member':>8} {'frames':>8} {'leaders':>8} {'fenced':>7} "
+        f"{'lag max':>8} {'inv':>5} {'loss':>6}  wal tail / state",
+    ]
+    for mid in sorted(data["members"]):
+        m = data["members"][mid]
+        if "err" in m:
+            lines.append(f"{mid:>8} ERR {m['err']}")
+            continue
+        lines.append(
+            f"{m['member']:>8} {m['frames']:>8} {m['leaders']:>8} "
+            f"{m['fenced']:>7} {m['lag_max']:>8} "
+            f"{str(m['invariant_trips']):>5} "
+            f"{str(m['router_loss']):>6}  {m['wal_tail']}")
+    lines.append("")
+    lines.append(f"top-{top} laggards (cluster-wide):")
+    if cl["top"]:
+        lines.append(
+            f"{'group':>8} {'member':>7} {'lag':>6} {'commit':>8} "
+            f"{'applied':>8} {'term':>6}  role")
+        for e in cl["top"]:
+            lines.append(
+                f"{e['group']:>8} {e['member']:>7} {e['lag']:>6} "
+                f"{e['commit']:>8} {e['applied']:>8} {e['term']:>6}  "
+                f"{e['role']}")
+    else:
+        lines.append("  (none — no row has uncommitted backlog)")
+    if cl["anomalies"]:
+        lines.append("")
+        lines.append("anomaly flags: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(cl["anomalies"].items())))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="fleet-console",
+                                description=__doc__)
+    p.add_argument("--admin", action="append", default=[],
+                   help="member admin endpoint host:port (repeatable "
+                        "or comma-separated)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh interval in seconds")
+    p.add_argument("--once", action="store_true",
+                   help="scrape and print once, then exit")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable rollup instead of "
+                        "the table")
+    p.add_argument("--top", type=int, default=8,
+                   help="laggard rows to show cluster-wide")
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+    addrs = [a for spec in args.admin for a in spec.split(",") if a]
+    if not addrs:
+        print("need at least one --admin host:port", file=sys.stderr)
+        return 2
+    while True:
+        data = collect(addrs, timeout=args.timeout, top=args.top)
+        if args.json:
+            out = json.dumps(data, indent=None if args.once else 1)
+        else:
+            out = render(data, top=args.top)
+        if not args.once:
+            # Clear + home, like watch(1): a refreshing console, not a
+            # scrolling log.
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(out, flush=True)
+        if args.once:
+            live = data["cluster"]["members_live"]
+            return 0 if live == data["cluster"]["members_total"] else 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Fleet smoke for tools/check.sh (ISSUE 10): boot a tiny in-process
+3-member hosting cluster with the fleet observatory on, serve each
+member's admin API in-process, and validate ``fleet_console --once
+--json`` end to end — a broken device SummaryFrame, admin 'fleet' op,
+or console rollup fails the static gate, not a live hosted run. One
+tiny compile (the chaos suite's config shape), no worker processes.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+from contextlib import redirect_stdout
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+G, R = 8, 3
+
+
+def main() -> int:
+    from etcd_tpu.batched.hosting import MultiRaftCluster
+    from etcd_tpu.batched.hosting_proc import AdminServer
+    from etcd_tpu.batched.state import BatchedConfig
+
+    import fleet_console
+
+    cfg = BatchedConfig(
+        num_groups=G, num_replicas=R, window=16, max_ents_per_msg=4,
+        max_props_per_round=4, election_timeout=10, heartbeat_timeout=1,
+        pre_vote=True, check_quorum=True, auto_compact=True,
+        telemetry=True, fleet_summary=True,
+    )
+    tmp = tempfile.mkdtemp(prefix="fleet_smoke_")
+    cluster = MultiRaftCluster(tmp, num_members=R, num_groups=G,
+                               cfg=cfg)
+    admins = []
+    try:
+        cluster.wait_leaders(timeout=120.0)
+        for g in range(G):
+            cluster.put(g, b"k%d" % g, b"v%d" % g, timeout=30.0)
+        # At least one summary frame folded on every member.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(m.fleet is not None and m.fleet.frames() > 0
+                   for m in cluster.members.values()):
+                break
+            time.sleep(0.05)
+        else:
+            print("fleet smoke: members never folded a summary frame",
+                  file=sys.stderr)
+            return 1
+
+        for m in cluster.members.values():
+            admins.append(AdminServer(m, cluster.router,
+                                      ("127.0.0.1", 0)))
+        addrs = [f"127.0.0.1:{a.addr[1]}" for a in admins]
+
+        # leaders_total is an instantaneous census from each member's
+        # latest frame — retry the exact-G check briefly rather than
+        # flake on a scrape that lands mid-frame on a loaded CI box.
+        deadline = time.monotonic() + 60.0
+        while True:
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                rc = fleet_console.main(
+                    ["--once", "--json"]
+                    + [x for a in addrs for x in ("--admin", a)])
+            if rc != 0:
+                print(f"fleet smoke: console exited {rc}",
+                      file=sys.stderr)
+                print(buf.getvalue()[-2000:], file=sys.stderr)
+                return 1
+            data = json.loads(buf.getvalue())
+            probs = fleet_console.validate_rollup(data)
+            if probs:
+                print(f"fleet smoke: invalid rollup: {probs}",
+                      file=sys.stderr)
+                return 1
+            cl = data["cluster"]
+            if cl["members_live"] != R:
+                print(f"fleet smoke: {cl['members_live']}/{R} "
+                      f"members live", file=sys.stderr)
+                return 1
+            if cl["leaders_total"] == G:
+                break
+            if time.monotonic() > deadline:
+                print(f"fleet smoke: leaders_total "
+                      f"{cl['leaders_total']} != {G}", file=sys.stderr)
+                return 1
+            time.sleep(0.5)
+        if cl["invariant_trips_total"] != 0:
+            print(f"fleet smoke: invariant trips "
+                  f"{cl['invariant_trips_total']}", file=sys.stderr)
+            return 1
+        # The table renderer must hold together on the same data too.
+        table = fleet_console.render(data)
+        if "top-8 laggards" not in table:
+            print("fleet smoke: table render incomplete",
+                  file=sys.stderr)
+            return 1
+        print(f"fleet smoke OK: {cl['members_live']} members, "
+              f"{cl['leaders_total']} leaders, lag_max "
+              f"{cl['lag_max']}, anomalies {cl['anomalies']}")
+        return 0
+    finally:
+        for a in admins:
+            a.close()
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
