@@ -161,7 +161,9 @@ class FaultyFabric:
 
     def __init__(self, plan: FaultPlan,
                  incarnation_fn: Optional[
-                     Callable[[int], Optional[object]]] = None) -> None:
+                     Callable[[int], Optional[object]]] = None,
+                 removed_fn: Optional[
+                     Callable[[int], bool]] = None) -> None:
         self.plan = plan
         # Target-incarnation seam for the delayed-delivery pump: maps a
         # member id to an identity token for its CURRENT live
@@ -171,6 +173,14 @@ class FaultyFabric:
         # NOR a crash+restart (a restarted member is a NEW incarnation
         # whose queues the crash tore). None = always deliver.
         self.incarnation_fn = incarnation_fn
+        # Config-removal seam (ISSUE 11): a member that LEFT the
+        # cluster config (removed voter) is treated like a crashed
+        # incarnation — frames to it drop and count (removed_drop,
+        # immediate and delayed paths both), and the harness issues a
+        # fresh incarnation token on re-admission so frames enqueued
+        # against the pre-removal identity can never leak into the
+        # re-added successor. None = nobody is ever config-removed.
+        self.removed_fn = removed_fn
         self._stats: Dict[str, int] = defaultdict(int)
         self._seq = itertools.count()
         self._cv = threading.Condition()
@@ -184,6 +194,14 @@ class FaultyFabric:
     def stats(self) -> Dict[str, int]:
         with self._cv:
             return dict(self._stats)
+
+    def _drop_kind(self, dst: int) -> str:
+        """Classify a dead-target drop: config-removed vs crashed —
+        the ONE classification site for both the enqueue-time and
+        fire-time drops."""
+        if self.removed_fn is not None and self.removed_fn(dst):
+            return "removed_drop"
+        return "crashed_drop"
 
     def _count(self, key: str, n: int = 1) -> None:
         with self._cv:
@@ -216,6 +234,12 @@ class FaultyFabric:
 
     def _ship(self, src: int, dst: int, deliver: Callable[[], None],
               n: int) -> None:
+        if self.removed_fn is not None and self.removed_fn(dst):
+            # Removed members are out of the cluster, not just slow:
+            # delivering would let a decommissioned replica keep
+            # participating (and its successor inherit its traffic).
+            self._count("removed_drop", n)
+            return
         if self.plan.blocked(src, dst):
             self._count("partitioned", n)
             return
@@ -245,8 +269,8 @@ class FaultyFabric:
         tok = (self.incarnation_fn(dst)
                if self.incarnation_fn is not None else None)
         if self.incarnation_fn is not None and tok is None:
-            # Target already crashed at enqueue time.
-            self._count("crashed_drop", n)
+            # Target already crashed (or config-removed) at enqueue.
+            self._count(self._drop_kind(dst), n)
             return
         with self._cv:
             if self._stopped:
@@ -279,9 +303,13 @@ class FaultyFabric:
             # mismatch means the enqueue-time incarnation is gone, and
             # its torn-away queues must not leak frames into a
             # successor (observed as phantom traffic after crash()).
+            # Config removal mismatches the same way: leaving the
+            # config retires the token, re-admission mints a new one,
+            # so a frame from the pre-removal era can never land in
+            # the re-added member.
             if self.incarnation_fn is not None \
                     and self.incarnation_fn(dst) is not tok:
-                self._count("crashed_drop", n)
+                self._count(self._drop_kind(dst), n)
                 continue
             self._run(deliver)
 
@@ -383,8 +411,17 @@ class ChaosHarness:
         self.pipeline = pipeline
         self.plan = FaultPlan(seed, spec)
         self.fabric = FaultyFabric(
-            self.plan, incarnation_fn=self._member_incarnation)
+            self.plan, incarnation_fn=self._member_incarnation,
+            removed_fn=self.is_removed)
         self.members: Dict[int, MultiRaftMember] = {}
+        # Incarnation tokens (fresh object per boot AND per config
+        # re-admission) + the config-removed set: a member removed from
+        # the cluster config is treated like a crashed incarnation by
+        # the fabric (frames drop and count as removed_drop), and
+        # mark_rejoined mints a NEW token so pre-removal frames in the
+        # delay heap can never leak into the re-added successor.
+        self._inc_tokens: Dict[int, object] = {}
+        self._removed: set = set()
         self.routers: Dict[int, TCPRouter] = {}
         self._ports: Dict[int, int] = {}  # stable rebind port per member
         self.inproc: Optional[InProcRouter] = (
@@ -444,18 +481,42 @@ class ChaosHarness:
             self.routers[mid] = router
         self.fabric.wrap(m)
         self.members[mid] = m
+        self._inc_tokens[mid] = object()  # new incarnation per boot
         return m
 
     def alive(self) -> List[MultiRaftMember]:
         return [m for m in self.members.values()
                 if not m._stopped.is_set()]
 
-    def _member_incarnation(self, mid: int) -> Optional[MultiRaftMember]:
-        """Incarnation seam for the fabric's delayed-delivery pump: the
-        member OBJECT is the identity token (a restart replaces it), or
-        None when the current incarnation is crashed/stopped."""
+    def _member_incarnation(self, mid: int) -> Optional[object]:
+        """Incarnation seam for the fabric's delayed-delivery pump: a
+        fresh token object per boot AND per config re-admission (a
+        restart replaces it, and so does mark_rejoined), or None when
+        the current incarnation is crashed/stopped/config-removed."""
         m = self.members.get(mid)
-        return m if (m is not None and not m._stopped.is_set()) else None
+        if m is None or m._stopped.is_set() or mid in self._removed:
+            return None
+        return self._inc_tokens.get(mid)
+
+    def is_removed(self, mid: int) -> bool:
+        """Whether `mid` is currently OUT of the cluster config (fully
+        removed voter — the decommissioned state between remove and
+        re-add)."""
+        return mid in self._removed
+
+    def mark_removed(self, mid: int) -> None:
+        """Declare `mid` removed from the cluster config: the fabric
+        drops (and counts) every frame to it, immediate and delayed —
+        a decommissioned replica must not keep participating."""
+        self._removed.add(mid)
+
+    def mark_rejoined(self, mid: int) -> None:
+        """Re-admit `mid` (e.g. re-added as learner): frames flow
+        again, under a NEW incarnation token — anything enqueued
+        against the pre-removal identity mismatches at fire time and
+        drops instead of leaking into the successor."""
+        self._inc_tokens[mid] = object()
+        self._removed.discard(mid)
 
     # -- process faults --------------------------------------------------------
 
@@ -649,6 +710,97 @@ class ChaosHarness:
                         b"t%d" % self.seed, timeout=per_put_timeout):
                 acked += 1
         return acked
+
+    # -- membership churn (ISSUE 11) -------------------------------------------
+
+    def reconfig_until(self, action: str, target: int,
+                       groups=None, timeout: float = 60.0,
+                       joint: bool = False) -> None:
+        """Drive a membership `action` for member `target` across
+        `groups` (default: all) until the change is APPLIED on each
+        group's current leader — the retry loop a real operator runs
+        under faults: "not-leader" redirects chase moving leaderships,
+        "not-ready" waits out the learner catch-up gate, mid-joint
+        refusals wait for auto-leave, and a leader that IS the removal
+        target gets its leadership transferred away first."""
+        groups = list(range(self.g)) if groups is None else \
+            [int(g) for g in groups]
+        t = int(target)
+        pred = {
+            "add-learner": lambda c, g: bool(c.learner[g, t - 1]),
+            "promote": lambda c, g: bool(
+                c.voter[g, t - 1] and not c.in_joint[g]),
+            "remove": lambda c, g: bool(
+                not c.voter[g, t - 1] and not c.learner[g, t - 1]
+                and not c.in_joint[g]),
+        }[action]
+        pending = set(groups)
+        deadline = time.monotonic() + timeout
+        spin = 0
+        # Re-propose a group's change only after a dwell: the apply
+        # latency is rounds, the poll loop is 50ms, and every duplicate
+        # proposal is a real log entry (refused idempotently at apply,
+        # but churning the log and the joint windows for nothing).
+        last_prop: Dict[int, float] = {}
+        while pending:
+            now = time.monotonic()
+            for g in sorted(pending):
+                for m in self.alive():
+                    if not m.is_leader(g):
+                        continue
+                    # Predicate under the member's lock: conf applies
+                    # are multi-step mutate-then-maybe-rollback, and an
+                    # unlocked read can observe a half-entered joint
+                    # (voter cleared, in_joint not yet set) as "done".
+                    with m._lock:
+                        satisfied = pred(m.conf, g)
+                    if satisfied:
+                        pending.discard(g)
+                        break
+                    if now - last_prop.get(g, -1e9) < 1.5:
+                        break
+                    last_prop[g] = now
+                    res = m.reconfig(action, t, [g], joint=joint)[g]
+                    if res == "self":
+                        # Removing the leader itself: hand leadership
+                        # to another voter first (etcd's discipline).
+                        others = [o.id for o in self.alive()
+                                  if o.id != t]
+                        m.transfer_leader(
+                            g, others[(g + spin) % len(others)])
+                    break
+            if not pending:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"reconfig {action} m{t}: groups {sorted(pending)[:8]} "
+                    f"never converged")
+            spin += 1
+            time.sleep(0.05)
+
+    def churn_member(self, mid: int, groups=None,
+                     timeout_each: float = 60.0,
+                     dwell: Optional[Callable[[], None]] = None) -> None:
+        """One full decommission/re-admission cycle for `mid`: remove
+        it as voter everywhere (joint-implicit change — enter-joint at
+        apply, auto-leave on the joint commit), mark it config-removed
+        on the fabric (frames drop like a crashed incarnation), run the
+        optional `dwell` workload while it is out, then re-admit under
+        a fresh incarnation token: add-as-learner → catch-up gate →
+        promote back to voter. Ends at full membership, so strict
+        checkers close."""
+        self.reconfig_until("remove", mid, groups=groups,
+                            timeout=timeout_each, joint=True)
+        if groups is None:
+            self.mark_removed(mid)
+        if dwell is not None:
+            dwell()
+        if groups is None:
+            self.mark_rejoined(mid)
+        self.reconfig_until("add-learner", mid, groups=groups,
+                            timeout=timeout_each)
+        self.reconfig_until("promote", mid, groups=groups,
+                            timeout=timeout_each, joint=True)
 
     def dump_flight_recorders(self, reason: str = "chaos") -> List[str]:
         """Dump every live member's telemetry flight recorder, fleet

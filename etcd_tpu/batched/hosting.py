@@ -54,13 +54,27 @@ from ..native.walog import (
 )
 from ..obs.tracer import make_tracer
 from ..pkg.failpoint import FailpointPanic, fp
-from ..raft.types import Message, MessageType, Snapshot, SnapshotMetadata
+from ..raft.confchange import ConfChangeError
+from ..raft.types import (
+    ConfChangeSingle,
+    ConfChangeTransition,
+    ConfChangeType,
+    ConfChangeV2,
+    EntryType,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+)
+from .membership import GroupConfStore, decode_conf_entry
 from .rawnode import BatchedRawNode, BatchedReady, RowRestore
 from .state import BatchedConfig, LEADER
 from .step import T_SNAP
 from .telemetry import (
     TelemetryHub,
     fenced_groups_gauge,
+    joint_groups_gauge,
+    learner_slots_gauge,
     round_phase_histogram,
     router_loss_counter,
     wal_fsync_histogram,
@@ -99,6 +113,14 @@ RT_ENTRY_BATCH = 5
 # tobytes replaces that many struct.pack + ctypes append calls.
 RT_HS_BATCH = 6
 RT_WM_BATCH = 7
+# Per-group membership configs, numpy-serialized full-state rows
+# (membership.GroupConfStore.pack_groups): written whenever committed
+# conf-change entries flip a group's config and at inbound-snapshot
+# conf restores, so _replay reconstructs config state without
+# re-reading the whole log — latest record per group wins, and conf
+# entries ABOVE the recorded watermark (committed but crashed before
+# the record landed) re-apply from the recovered entries themselves.
+RT_CONF_BATCH = 8
 
 # Per-entry header inside an RT_ENTRY_BATCH record (packed, 25 bytes —
 # the same fields as RT_ENTRY's "<IQQBI" header, SoA-serializable).
@@ -320,6 +342,22 @@ class MultiRaftMember:
         self._boot_fenced = 0
         self._g_fenced = fenced_groups_gauge().labels(str(member_id))
 
+        # Per-group membership configs (joint-consensus control plane,
+        # ISSUE 11): the replicated log drives it — committed
+        # EntryConfChange/V2 entries apply here, flip the device
+        # voter/learner/in_joint lanes via one bulk mask upload, and
+        # WAL-record the result (RT_CONF_BATCH) so _replay reconstructs
+        # config state across crashes. Guarded by _lock.
+        self.conf = GroupConfStore(num_groups, self.cfg.num_replicas)
+        self._g_joint = joint_groups_gauge().labels(str(member_id))
+        self._g_learners = learner_slots_gauge().labels(str(member_id))
+        # Auto-leave-joint re-proposal cooldowns (row -> monotonic s):
+        # the leave is proposed at the joint entry's apply on the
+        # leader; the sweep in run_round is the fallback for groups
+        # whose leadership moved mid-joint.
+        self._joint_prop: Dict[int, float] = {}
+        self._next_joint_sweep = 0.0
+
         restore = self._replay()
         groups = np.arange(num_groups, dtype=np.int32)
         slots = np.full(num_groups, self.slot, np.int32)
@@ -341,6 +379,15 @@ class MultiRaftMember:
             self.cfg, groups=groups, slots=slots, restore=restore,
             mesh=mesh,
         )
+        # Replayed membership configs onto the device before the first
+        # round: the staged masks apply at the head of advance_round,
+        # ahead of any delivery/tick — a recovered group must never run
+        # one round on the boot all-voter electorate.
+        conf_rows = self.conf.non_default_groups()
+        if len(conf_rows):
+            self.rn.set_membership_many(conf_rows,
+                                        *self.conf.masks(conf_rows))
+        self._update_conf_gauges()
         # Proposal-lifecycle tracer (etcd_tpu.obs, ISSUE 9): sampled
         # spans stamped at every pipeline stage. trace=None defers to
         # ETCD_TPU_TRACE (off by default); purely host-side, so the
@@ -477,6 +524,13 @@ class MultiRaftMember:
                         wmb["last_term"].tolist(),
                         wmb["commit"].tolist()):
                     wms[g] = (wl, wt, wc)
+            elif rtype == RT_CONF_BATCH:
+                # Full-state config rows; records replay in WAL order,
+                # so the last row loaded per group is the newest.
+                for g, idx, flags, slots in \
+                        GroupConfStore.unpack_groups(
+                            data, self.cfg.num_replicas):
+                    self.conf.load_record(g, idx, flags, slots)
         restore: Dict[int, RowRestore] = {}
         for g in set(rows) | set(ents) | set(snaps):
             rr = rows[g]
@@ -492,6 +546,29 @@ class MultiRaftMember:
             # here when a crash lands between the RT_SNAPSHOT record
             # and the next hardstate record.
             restore[g] = rr
+            # Committed conf entries ABOVE the group's recorded conf
+            # watermark (the crash landed after the entry's fsync but
+            # before the RT_CONF_BATCH record / its fsync): re-apply
+            # them now, in log order, so the device masks staged at
+            # boot reflect every conf change the quorum may have acted
+            # on. Entries above the recovered commit re-apply later
+            # through the normal Ready path when they (re-)commit —
+            # applying early would run a config the group never
+            # committed (the apply-at-commit discipline, etcd-style).
+            commit_eff = max(rr.commit, rr.snap_index)
+            for ent in rr.entries:
+                idx, _t, d = ent[0], ent[1], ent[2]
+                et = ent[3] if len(ent) > 3 else 0
+                if (et and idx <= commit_eff
+                        and idx > self.conf.applied_index[g]):
+                    try:
+                        cc = decode_conf_entry(d or b"", et)
+                    except ValueError:
+                        _log.warning(
+                            "member %d: undecodable conf entry "
+                            "g%d i%d at replay", self.id, g, idx)
+                        continue
+                    self.conf.apply(g, idx, cc)
         # -- durable bookkeeping + fence decision per group ----------------
         for g, rr in restore.items():
             rec_last = rr.entries[-1][0] if rr.entries else rr.snap_index
@@ -629,6 +706,7 @@ class MultiRaftMember:
         t0 = time.perf_counter()
         rd = self.rn.advance_round()
         self.rn.advance()
+        self._joint_sweep()  # time-gated; no-op while nothing is joint
         self.stats["rounds"] += 1
         dt = time.perf_counter() - t0
         self.stats["round_s"] += dt
@@ -774,17 +852,48 @@ class MultiRaftMember:
         if self._crashed:
             return  # dead members neither apply nor send
         t0 = time.perf_counter()
+        conf_changed: List[int] = []
+        auto_leave_rows: List[int] = []
         with self._lock:
+            if self._crashed:
+                return  # re-check under _lock: crash() closed the WAL
             # 2. apply committed payloads (persist already happened in
-            #    _process_readys; the batch fsync precedes every send)
+            #    _process_readys; the batch fsync precedes every send).
+            #    Conf-change entries apply to the membership control
+            #    plane instead of the KV state machine: the new config
+            #    flips the device voter/learner/in_joint lanes via one
+            #    bulk mask upload after the loop (ref: raft.go:896
+            #    applyConfChange; SURVEY §2.1 host-side control plane).
             for row, items in rd.committed:
                 for i, _t, d, et in items:
-                    # Conf-change entries are membership, not KV data
-                    # (this hosting demo runs fixed-membership groups;
-                    # the type tag keeps them out of the state machine).
-                    if d and et == 0:
-                        self.kvs[row].apply(d)
+                    if et == 0:
+                        if d:
+                            self.kvs[row].apply(d)
+                    else:
+                        self._apply_conf_entry(
+                            row, i, d or b"", et, conf_changed,
+                            auto_leave_rows)
                     self.applied_index[row] = i
+            if conf_changed:
+                # WAL-record the new configs before anything downstream
+                # of them can be acknowledged; the next batch fsync
+                # covers the record, and a crash before it re-derives
+                # the state from the (already fsync'd) entries at
+                # _replay.
+                conf_changed = sorted(set(conf_changed))
+                rows = np.asarray(conf_changed)
+                self.wal.append(RT_CONF_BATCH,
+                                self.conf.pack_groups(rows))
+                # Stage the device masks UNDER the same lock as the
+                # conf mutation (member._lock -> rn._lock nesting is
+                # established — install_snapshot_state does the same):
+                # reading or staging after release races deliver()'s
+                # snapshot conf restore — torn mask planes, or a stale
+                # older config overwriting a newer staging for the
+                # same row (rn._pending_conf is last-writer-wins).
+                self.rn.set_membership_many(rows,
+                                            *self.conf.masks(rows))
+                self._update_conf_gauges()
             # 3a. build outbound batch (MsgSnap carries app state at the
             #     host's applied watermark, ≥ the device floor after
             #     step 2; the floor metadata rides in m.index/log_term)
@@ -807,10 +916,20 @@ class MultiRaftMember:
                         else m.log_term
                     )
                     m.snapshot = Snapshot(
-                        metadata=SnapshotMetadata(index=idx, term=t),
+                        # The config at the snapshot point rides the
+                        # metadata (raft.proto ConfState): conf entries
+                        # in the skipped log never reach the receiver,
+                        # so the snapshot must carry membership or a
+                        # rejoining member restores data without its
+                        # config (ref: confchange/restore.go).
+                        metadata=SnapshotMetadata(
+                            index=idx, term=t,
+                            conf_state=self.conf.conf_state(row)),
                         data=self.kvs[row].snapshot(),
                     )
                 out.append((row, m))
+        if conf_changed:
+            self._post_conf_apply(conf_changed, auto_leave_rows)
         # Apply instant captured here, stamped at the END of this
         # function: "apply" retires a span, and a same-round
         # append+commit (solo group) must take its "send" stamp first.
@@ -871,6 +990,215 @@ class MultiRaftMember:
         self.stats["send_s"] += dt
         if self._h_phase is not None:
             self._h_phase["send"].observe(dt)
+
+    # -- membership (joint-consensus conf changes, ISSUE 11) -------------------
+
+    def _apply_conf_entry(self, row: int, index: int, data: bytes,
+                          etype: int, changed: List[int],
+                          auto_rows: List[int]) -> None:
+        """Apply one committed conf-change entry to the control plane
+        (caller holds _lock). Undecodable bytes and deterministic
+        refusals are logged and skipped — every member sees the same
+        bytes at the same index, so every member skips identically."""
+        try:
+            cc = decode_conf_entry(data, etype)
+        except ValueError:
+            _log.warning("member %d: undecodable conf entry g%d i%d",
+                         self.id, row, index)
+            return
+        err = self.conf.apply(row, index, cc)
+        if err is not None:
+            if err != "stale":
+                _log.info("member %d: conf change g%d i%d refused: %s",
+                          self.id, row, index, err)
+            return
+        changed.append(row)
+        if self.conf.in_joint[row] and self.conf.auto_leave[row]:
+            auto_rows.append(row)
+
+    def _post_conf_apply(self, changed: List[int],
+                         auto_rows: List[int]) -> None:
+        """Follow-on actions a leader owes a freshly applied config
+        (the masks themselves were staged under _lock by the caller):
+        an immediate append/probe to changed membership
+        (switchToConfig → maybeSendAppend) and the auto-leave proposal
+        for implicit joint entries (raft.go advance() proposing the
+        zero ConfChangeV2)."""
+        for row in changed:
+            if self.rn.is_leader(row):
+                # Newly admitted members must be contacted now, not at
+                # the next heartbeat timeout.
+                self.rn.poke_append(row)
+        for row in sorted(set(auto_rows)):
+            if self.rn.is_leader(row):
+                self._propose_leave_joint(row)
+        self._work.set()
+
+    def _propose_leave_joint(self, row: int) -> None:
+        """Propose the empty ConfChangeV2 that exits an auto-leave
+        joint config, at most once per row per cooldown window (a
+        duplicate leave landing after the exit refuses idempotently at
+        apply)."""
+        now = time.monotonic()
+        if now - self._joint_prop.get(row, 0.0) < 1.0:
+            return
+        self._joint_prop[row] = now
+        self.rn.propose(row, ConfChangeV2().marshal(),
+                        etype=int(EntryType.EntryConfChangeV2))
+        self._work.set()
+
+    def _joint_sweep(self) -> None:
+        """Fallback auto-leave driver (run_round, time-gated): the
+        leave is normally proposed at the joint entry's apply on the
+        leader, but leadership can move mid-joint — the NEW leader must
+        exit the joint config or the group is stuck needing both
+        quorums forever (the classic place multi-raft breaks; the
+        check_config_safety 'joint always exited' clause watches it)."""
+        now = time.monotonic()
+        if now < self._next_joint_sweep:
+            return
+        self._next_joint_sweep = now + 0.25
+        with self._lock:
+            rows = np.nonzero(self.conf.in_joint
+                              & self.conf.auto_leave)[0]
+        for row in rows.tolist():
+            if self.rn.is_leader(row):
+                self._propose_leave_joint(row)
+
+    def _update_conf_gauges(self) -> None:
+        self._g_joint.set(int(self.conf.in_joint.sum()))
+        self._g_learners.set(int(self.conf.learner.sum()))
+
+    def propose_conf(self, group: int, cc) -> bool:
+        """Propose a membership change through `group`'s log (leaders
+        only — returns False otherwise so callers redirect like
+        clients). Accepts ConfChange or ConfChangeV2; always marshals
+        as an EntryConfChangeV2 record. A new change while the group is
+        mid-joint is refused loudly (ConfChangeError) — one config
+        transition in flight per group, the reference's
+        pendingConfIndex discipline — except the empty leave-joint."""
+        cc2 = cc.as_v2()
+        if not self.rn.is_leader(group):
+            return False
+        with self._lock:
+            if self.conf.in_joint[group] and not cc2.leave_joint():
+                raise ConfChangeError(
+                    f"group {group} is mid-joint; only the leave-joint "
+                    "change may be proposed")
+        self.rn.propose(group, cc2.marshal(),
+                        etype=int(EntryType.EntryConfChangeV2))
+        self._work.set()
+        return True
+
+    # Learner promotable once its match covers this share of the
+    # leader's (ref: server.go:1473 readyPercent).
+    LEARNER_READY_PERCENT = 0.9
+
+    def reconfig(self, action: str, target_member: int, groups,
+                 joint: bool = False) -> Dict[int, str]:
+        """Batched membership admin over the groups this member leads:
+        ``add-learner`` / ``promote`` (catch-up-gated) / ``remove``.
+        Returns a per-group result string: "ok" (proposed), or why not
+        ("not-leader", "not-learner", "not-ready:<match>/<last>",
+        "self", "refused:<reason>"). ``joint=True`` proposes the change
+        with an implicit joint transition (enter-joint at apply,
+        auto-leave once the joint config commits) — the batched
+        joint-consensus path."""
+        t = int(target_member)
+        if not 1 <= t <= self.cfg.num_replicas:
+            raise ValueError(
+                f"member {t} outside replica capacity "
+                f"R={self.cfg.num_replicas}")
+        kind = {
+            "add-learner": ConfChangeType.ConfChangeAddLearnerNode,
+            "promote": ConfChangeType.ConfChangeAddNode,
+            "remove": ConfChangeType.ConfChangeRemoveNode,
+        }.get(action)
+        if kind is None:
+            raise ValueError(f"unknown reconfig action {action!r}")
+        match = self.rn.peer_match() if action == "promote" else None
+        results: Dict[int, str] = {}
+        for g in groups:
+            g = int(g)
+            if not self.rn.is_leader(g):
+                results[g] = "not-leader"
+                continue
+            if action == "promote":
+                with self._lock:
+                    is_learner = bool(self.conf.learner[g, t - 1])
+                if not is_learner:
+                    results[g] = "not-learner"
+                    continue
+                # Catch-up gate (the PR 1 promote_member gate, read
+                # from the leader's device progress view): the learner
+                # must cover >= LEARNER_READY_PERCENT of the leader's
+                # own log before its vote starts counting.
+                lead_last = int(self.rn.m_last[g])
+                lm = int(match[g, t - 1])
+                if lead_last > 0 and (
+                        lm < lead_last * self.LEARNER_READY_PERCENT):
+                    results[g] = f"not-ready:{lm}/{lead_last}"
+                    continue
+            if action == "remove" and t == self.id:
+                # Removing the leader through itself wedges the group's
+                # proposals mid-flight; transfer leadership away first.
+                results[g] = "self"
+                continue
+            cc = ConfChangeV2(changes=[ConfChangeSingle(kind, t)])
+            if joint:
+                cc.transition = (
+                    ConfChangeTransition.ConfChangeTransitionJointImplicit)
+            try:
+                results[g] = ("ok" if self.propose_conf(g, cc)
+                              else "not-leader")
+            except ConfChangeError as e:
+                results[g] = f"refused:{e}"
+        return results
+
+    def wait_transfers(self, groups, to_member: int,
+                       timeout: float = 5.0) -> Tuple[List[int],
+                                                      List[int]]:
+        """Bounded wait for staged leadership transfers: a group is
+        done once this member no longer leads it (the transferee's
+        TimeoutNow campaign displaced us) or it already names the
+        target as leader. Returns (done, pending-at-timeout)."""
+        pending = {int(g) for g in groups}
+        done: List[int] = []
+        deadline = time.monotonic() + timeout
+        while pending and time.monotonic() < deadline:
+            for g in list(pending):
+                if (not self.rn.is_leader(g)
+                        or self.rn.lead(g) == to_member):
+                    pending.discard(g)
+                    done.append(g)
+            if pending:
+                time.sleep(0.01)
+        return sorted(done), sorted(pending)
+
+    def conf_snapshot(self) -> Dict[str, object]:
+        """Membership rollup for checkers/admin (checker duck-type:
+        functional.checker.check_config_safety)."""
+        with self._lock:
+            c = self.conf
+            return {
+                "voters": [tuple((np.nonzero(c.voter[g])[0]
+                                  + 1).tolist())
+                           for g in range(self.g)],
+                "voters_out": [tuple((np.nonzero(c.voter_out[g])[0]
+                                      + 1).tolist())
+                               for g in range(self.g)],
+                "learners": [tuple((np.nonzero(c.learner[g])[0]
+                                    + 1).tolist())
+                             for g in range(self.g)],
+                "in_joint": c.in_joint.copy(),
+                "applied_index": c.applied_index.copy(),
+                "epoch": c.epoch.copy(),
+                "refused": int(c.refused),
+            }
+
+    def conf_history(self, group: int) -> List[Dict]:
+        with self._lock:
+            return self.conf.history(group)
 
     # -- durability fence ------------------------------------------------------
 
@@ -934,6 +1262,10 @@ class MultiRaftMember:
                 int(g): int(self._wm_last[g] - self._dur_last[g])
                 for g in fenced
             }
+            joint_groups = int(self.conf.in_joint.sum())
+            learner_slots = int(self.conf.learner.sum())
+            conf_applied = int(self.conf.epoch.sum())
+            conf_refused = int(self.conf.refused)
         return {
             "fence_enabled": self.fence_enabled,
             "wal_tail": (TAIL_NAMES.get(self._tail_state, "unknown")
@@ -941,6 +1273,13 @@ class MultiRaftMember:
             "fenced_groups": [int(g) for g in fenced],
             "catchup_gap": gaps,
             "boot_fenced": self._boot_fenced,
+            # Membership control plane (ISSUE 11): live joint/learner
+            # census + applied/refused conf-change totals — the
+            # fleet_console joint/learner columns read these.
+            "joint_groups": joint_groups,
+            "learner_slots": learner_slots,
+            "conf_applied": conf_applied,
+            "conf_refused": conf_refused,
             "crashed": self._crashed,
             "stopped": self._stopped.is_set(),
         }
@@ -975,6 +1314,26 @@ class MultiRaftMember:
                         _pack_snap(group, idx, snap_term,
                                    m.snapshot.data),
                     )
+                    # Membership rides the snapshot metadata: conf
+                    # entries in the skipped log never arrive, so the
+                    # carried ConfState supersedes whatever this member
+                    # last applied (raft.restore → confchange.Restore).
+                    cs = m.snapshot.metadata.conf_state
+                    if cs is not None and cs.voters:
+                        if self.conf.restore(group, idx, cs):
+                            rows = np.asarray([group])
+                            self.wal.append(
+                                RT_CONF_BATCH,
+                                self.conf.pack_groups(rows))
+                            # Stage under the SAME lock as the conf
+                            # mutation (see the conf-apply path): a
+                            # post-release staging can lose the
+                            # last-writer-wins race against a
+                            # concurrent apply and leave the device
+                            # on the older config.
+                            self.rn.set_membership_many(
+                                rows, *self.conf.masks(rows))
+                            self._update_conf_gauges()
                     # Snapshot-driven heal: the install makes (idx,
                     # snap_term) durable and committed, so the durable
                     # mirrors jump with it and a fence demanding

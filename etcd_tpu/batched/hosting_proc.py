@@ -132,7 +132,52 @@ class AdminServer:
                 return {"err": f"transfer target must be a member id "
                                f"1..{m.cfg.num_replicas}, got {to!r}"}
             moved = [g for g in req["groups"] if m.transfer_leader(g, to)]
-            return {"ok": True, "moved": len(moved)}
+            # Bounded wait-for-completion (default on; wait_s=0 keeps
+            # the old fire-and-forget): a transfer is DONE once this
+            # member no longer leads the group (the transferee's
+            # TimeoutNow campaign displaced it) — callers like
+            # rebalancerd need completion, not staging, and an
+            # unbounded wait would wedge the admin lane on a wedged
+            # transferee.
+            wait_s = float(req.get("wait_s", 5.0))
+            done, pending = (m.wait_transfers(moved, to, timeout=wait_s)
+                             if wait_s > 0 and moved else (moved, []))
+            return {"ok": True, "moved": len(moved), "done": done,
+                    "pending": pending}
+        if op == "reconfig":
+            # Batched membership admin (ISSUE 11): add-learner /
+            # promote (catch-up-gated) / remove, proposed through the
+            # log on groups this member leads; per-group results tell
+            # the driver exactly what to retry where ("not-leader" →
+            # redirect, "not-ready" → wait for catch-up, "refused" →
+            # illegal against the current config).
+            action = req["action"]
+            target = req["member"]
+            if (not isinstance(target, int)
+                    or not 1 <= target <= m.cfg.num_replicas):
+                return {"err": f"reconfig member must be a member id "
+                               f"1..{m.cfg.num_replicas}, got {target!r}"}
+            try:
+                res = m.reconfig(action, target, req["groups"],
+                                 joint=bool(req.get("joint", False)))
+            except ValueError as e:
+                return {"err": str(e)}
+            ok_n = sum(1 for v in res.values() if v == "ok")
+            return {"ok": True, "proposed": ok_n,
+                    "results": {str(g): v for g, v in res.items()}}
+        if op == "conf":
+            # Membership rollup: per-group voters/learners/joint state
+            # plus applied/refused totals (check_config_safety's admin
+            # face; fleet_console reads the cheaper health census).
+            snap = m.conf_snapshot()
+            return {"ok": True,
+                    "voters": [list(v) for v in snap["voters"]],
+                    "learners": [list(v) for v in snap["learners"]],
+                    "voters_out": [list(v) for v in snap["voters_out"]],
+                    "in_joint": [int(x) for x in snap["in_joint"]],
+                    "applied_index":
+                        [int(x) for x in snap["applied_index"]],
+                    "refused": snap["refused"]}
         if op == "prof_reset":
             for k in list(m.stats):
                 m.stats[k] = 0 if isinstance(m.stats[k], int) else 0.0

@@ -187,6 +187,13 @@ def invariant_bits(st, slot) -> jnp.ndarray:
         # fenced leader means the fence lane failed to gate an
         # election path — the exact hazard the fence exists to close.
         st.fenced & is_leader,
+        # outgoing-voter residue outside a joint config: voter_out only
+        # means anything while in_joint (quorum/commit read it through
+        # the joint gates), so a nonzero row with in_joint false is a
+        # conf-apply that flipped the lanes inconsistently — stale
+        # outgoing voters would silently rejoin the electorate the
+        # moment a later change re-enters joint.
+        ~st.in_joint & jnp.any(st.voter_out),
     ]
     bits = jnp.zeros((), I32)
     for i, b in enumerate(bad):

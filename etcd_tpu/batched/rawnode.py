@@ -488,6 +488,26 @@ class BatchedRawNode:
                 bool(joint),
             )
 
+    def set_membership_many(self, rows, voter, voter_out, learner,
+                            joint) -> None:
+        """Bulk set_membership: stage mask planes for many rows under
+        ONE lock acquisition — the conf-apply fast path when thousands
+        of groups reconfigure in the same round (the hosting layer
+        hands the GroupConfStore mask planes straight through). Same
+        staged semantics: the device edit lands at the head of the next
+        round on the round thread, as one vectorized ``.at[rows].set``.
+        """
+        rows = np.asarray(rows, np.int64)
+        voter = np.asarray(voter, bool)
+        voter_out = np.asarray(voter_out, bool)
+        learner = np.asarray(learner, bool)
+        joint = np.asarray(joint, bool)
+        with self._lock:
+            for i, row in enumerate(rows.tolist()):
+                self._pending_conf[row] = (
+                    voter[i], voter_out[i], learner[i], bool(joint[i]),
+                )
+
     def transfer_leader(self, row: int, target_slot: int) -> None:
         """Stage a leadership handoff request on a leader row
         (ref: raft.go:1339 MsgTransferLeader; device _control phase)."""
@@ -652,6 +672,7 @@ class BatchedRawNode:
         # Host-staged device-state edits (membership masks, ring-floor
         # compaction, bcastAppend pokes), applied here on the round
         # thread — the only writer of self.state.
+        conf_rows = None  # rows whose membership masks flip this round
         if pend_conf:
             st0 = self.state
             rows2 = np.fromiter(pend_conf, np.int32, len(pend_conf))
@@ -667,6 +688,7 @@ class BatchedRawNode:
                 learner=st0.learner.at[ridx].set(jnp.asarray(lrn)),
                 in_joint=st0.in_joint.at[ridx].set(jnp.asarray(jnt)),
             )
+            conf_rows = rows2
         if pend_fence:
             st0 = self.state
             rows2 = np.fromiter(pend_fence, np.int32, len(pend_fence))
@@ -744,6 +766,17 @@ class BatchedRawNode:
             # were accumulated in-kernel; no extra sync happens here.
             tel_counters = np.asarray(frame.counters)
             tel_inv = np.asarray(frame.invariants)
+            if conf_rows is not None and len(conf_rows):
+                # Host-populated column (see telemetry.TM_NAMES): the
+                # membership masks of these rows flipped at the head of
+                # THIS round — count them where they were staged so the
+                # flight recorder shows per-group conf applies in the
+                # same frame stream as the device events.
+                from .telemetry import TM_INDEX
+
+                tel_counters = tel_counters.copy()
+                tel_counters[np.asarray(conf_rows, np.int64),
+                             TM_INDEX["conf_changes_applied"]] += 1
             self.last_frame = (tel_counters, tel_inv)
             if self.telemetry_hub is not None:
                 from .telemetry import lane_summary
@@ -1230,6 +1263,16 @@ class BatchedRawNode:
         return block, msgs
 
     # -- introspection ---------------------------------------------------------
+
+    def peer_match(self) -> np.ndarray:
+        """Leader-side [n, R] match snapshot — the promote catch-up
+        gate's input (server.go:1446 isLearnerReady reads the same
+        progress view). A plain np.asarray of the live device buffer:
+        zero-copy on CPU, one bulk fetch elsewhere; called at admin
+        cadence, never on the round hot path. Rows this process does
+        not lead carry reset-stale values — callers gate on leadership
+        first."""
+        return np.asarray(self.state.match)
 
     def latest_ring(self) -> np.ndarray:
         """The newest known [n, W] term ring (in-flight round if any)."""

@@ -1,0 +1,332 @@
+"""Anomaly-driven leadership rebalancer for the batched hosting path.
+
+Closes the loop the fleet observatory (obs/fleet.py, ISSUE 10) opened:
+its device-side SummaryFrames already surface **when** leadership is
+skewed (the ``leader_skew`` anomaly / the per-slot leader census behind
+``etcd_tpu_fleet_leader_groups``), **who** is overloaded (the census
+again — each hosting member's frame counts the groups its own rows
+lead), and **which** groups are hurting (``commit_frozen`` plus the
+top-K worst-backlogged rows, WITH group identities). This module turns
+those signals into action:
+
+* **when** — a pass triggers when the observed leader balance exceeds
+  ``skew_ratio`` × the fair share (the same quantity the fleet hub's
+  ``leader_skew`` flag edge-triggers on), or when a member's rollup
+  carries a fresh ``leader_skew`` anomaly;
+* **donors/receivers** — the member leading the most groups donates to
+  the members below fair share, emptiest first;
+* **priority** — donor-led groups that the observatory flagged
+  (``commit_frozen`` log entries, merged top-K laggard ids) move FIRST:
+  a lagging group on an overloaded leader is the one whose tail
+  latency the move actually fixes;
+* **actuation** — ``MsgTransferLeader`` per group (the admin
+  ``transfer`` op / ``MultiRaftMember.transfer_leader``), each move
+  awaited with a bounded timeout and retried at most ``max_retries``
+  times. For full **migration** (move the replica, not just the
+  lease), drive the membership ops instead: add-as-learner →
+  snapshot-rejoin (an inbound snapshot ≥ watermark lifts fences,
+  hosting.deliver) → promote → remove old voter (``reconfig``).
+
+Flap-proofing is structural, not probabilistic: a group is never moved
+twice within ``cooldown_s`` (whatever the signals claim), a pass moves
+at most ``max_moves_per_pass`` groups, and a transfer that will not
+complete is abandoned after ``max_retries`` bounded waits — so a noisy
+or adversarial signal stream degrades to "no action", never to
+leadership churn (proven by the flap-injection test in
+tests/batched/test_rebalance.py).
+
+Two actuators speak the same duck-typed surface: ``InProcActuator``
+(tests, single-process clusters) and ``AdminActuator`` (the
+``tools/rebalancerd.py`` daemon over the hosting admin API). No
+bespoke probes: every decision input is a fleet rollup, every action an
+existing admin op.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+_log = logging.getLogger("etcd_tpu.batched.rebalance")
+
+
+@dataclass(frozen=True)
+class RebalanceConfig:
+    """Policy knobs. ``skew_ratio`` doubles as trigger and convergence
+    bar: a pass fires above it and reports converged at-or-below it
+    (matching the fleet hub's leader_skew flag semantics)."""
+
+    skew_ratio: float = 1.5
+    cooldown_s: float = 10.0  # per-group re-move quarantine
+    max_moves_per_pass: int = 64
+    max_retries: int = 3  # bounded transfer attempts per move
+    transfer_wait_s: float = 5.0
+    min_groups: int = 8  # tiny clusters are never "skewed"
+
+
+@dataclass
+class Move:
+    group: int
+    frm: int
+    to: int
+    attempts: int = 0
+    ok: bool = False
+    reason: str = ""  # why this group was picked (laggard/frozen/fill)
+
+
+class InProcActuator:
+    """Actuator over in-process MultiRaftMembers (tests, smokes)."""
+
+    def __init__(self, members: Dict[int, object]) -> None:
+        self._members = members
+
+    def members(self) -> List[int]:
+        return sorted(self._members)
+
+    def rollup(self, mid: int) -> Optional[Dict]:
+        m = self._members.get(mid)
+        fleet = getattr(m, "fleet", None)
+        return fleet.snapshot() if fleet is not None else None
+
+    def led_groups(self, mid: int) -> List[int]:
+        return self._members[mid].rn.leader_rows().tolist()
+
+    def transfer(self, mid: int, groups: List[int], to: int,
+                 wait_s: float) -> Tuple[List[int], List[int]]:
+        m = self._members[mid]
+        staged = [g for g in groups if m.transfer_leader(g, to)]
+        missed = [g for g in groups if g not in staged]
+        done, pending = m.wait_transfers(staged, to, timeout=wait_s)
+        return done, pending + missed
+
+
+class AdminActuator:
+    """Actuator over the hosting admin API (rebalancerd's transport):
+    ``fleet`` rollups in, ``leaders``/``transfer`` ops out."""
+
+    def __init__(self, addrs: Dict[int, Tuple[str, int]],
+                 timeout: float = 30.0) -> None:
+        from .hosting_proc import ProcClient
+
+        self._clients = {mid: ProcClient(a, timeout=timeout)
+                         for mid, a in addrs.items()}
+
+    def members(self) -> List[int]:
+        return sorted(self._clients)
+
+    def _call(self, mid: int, **req) -> Optional[Dict]:
+        try:
+            r = self._clients[mid].call(**req)
+        except (OSError, ConnectionError, ValueError):
+            return None
+        return r if r.get("ok") else None
+
+    def rollup(self, mid: int) -> Optional[Dict]:
+        r = self._call(mid, op="fleet")
+        return r.get("rollup") if r else None
+
+    def led_groups(self, mid: int) -> List[int]:
+        r = self._call(mid, op="leaders")
+        if not r:
+            return []
+        return [g for g, lead in enumerate(r.get("leads", []))
+                if lead == mid]
+
+    def transfer(self, mid: int, groups: List[int], to: int,
+                 wait_s: float) -> Tuple[List[int], List[int]]:
+        r = self._call(mid, op="transfer", to=to, groups=groups,
+                       wait_s=wait_s)
+        if not r:
+            return [], list(groups)
+        return list(r.get("done", [])), list(r.get("pending", []))
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            c.close()
+
+
+class Rebalancer:
+    """One rebalancing control loop over an actuator. ``run_once`` is
+    the whole contract: observe → decide → (maybe) move → re-observe;
+    ``rebalancerd --once --json`` prints its report verbatim."""
+
+    def __init__(self, actuator, cfg: Optional[RebalanceConfig] = None,
+                 clock=time.monotonic) -> None:
+        self.act = actuator
+        self.cfg = cfg or RebalanceConfig()
+        self._clock = clock
+        self._last_move: Dict[int, float] = {}  # group -> move instant
+        self._seen_skew: Dict[int, int] = {}  # edge-detect anomaly counts
+
+    # -- observe ---------------------------------------------------------------
+
+    def observe(self) -> Dict:
+        """Scrape every member's fleet rollup into one decision view:
+        leader balance, skew ratio, fresh leader_skew anomalies, and
+        the flagged groups (commit_frozen + merged top-K laggards)."""
+        balance: Dict[int, int] = {}
+        flagged: List[Tuple[int, str]] = []  # (group, reason), ordered
+        fresh_skew = False
+        groups = 0
+        for mid in self.act.members():
+            roll = self.act.rollup(mid)
+            if roll is None:
+                continue
+            balance[mid] = int(roll.get("leaders_total", 0))
+            groups = max(groups, int(roll.get("groups", 0) or 0))
+            counts = roll.get("anomalies", {})
+            skew_n = int(counts.get("leader_skew", 0))
+            if skew_n > self._seen_skew.get(mid, 0):
+                fresh_skew = True
+            self._seen_skew[mid] = skew_n
+            for a in roll.get("anomaly_log", []):
+                if a.get("kind") == "commit_frozen" and "group" in a:
+                    flagged.append((int(a["group"]), "commit_frozen"))
+            for e in roll.get("top", []):
+                flagged.append((int(e["group"]), "laggard"))
+        total = sum(balance.values())
+        fair = total / max(len(balance), 1)
+        ratio = (max(balance.values()) / fair
+                 if balance and fair > 0 else 0.0)
+        return {
+            "members_seen": len(balance),
+            "balance": balance,
+            "groups": groups,
+            "fair": fair,
+            "ratio": ratio,
+            "fresh_skew": fresh_skew,
+            "flagged": flagged,
+        }
+
+    # -- decide ----------------------------------------------------------------
+
+    def plan(self, view: Dict) -> Tuple[List[Move], int]:
+        """Moves for one pass (may be empty), plus how many candidate
+        groups the per-group cooldown vetoed."""
+        cfg = self.cfg
+        balance = dict(view["balance"])
+        if (len(balance) < 2 or view["groups"] < cfg.min_groups
+                or view["fair"] <= 0):
+            return [], 0
+        if not (view["ratio"] > cfg.skew_ratio or view["fresh_skew"]):
+            return [], 0
+        donor = max(balance, key=lambda m: balance[m])
+        excess = balance[donor] - int(view["fair"] + 0.5)
+        if excess <= 0:
+            return [], 0
+        led = self.act.led_groups(donor)
+        led_set = set(led)
+        reason_of: Dict[int, str] = {}
+        ordered: List[int] = []
+        for g, why in view["flagged"]:
+            if g in led_set and g not in reason_of:
+                reason_of[g] = why
+                ordered.append(g)
+        ordered += [g for g in led if g not in reason_of]
+        now = self._clock()
+        cooled: List[int] = []
+        vetoed = 0
+        for g in ordered:
+            if now - self._last_move.get(g, -1e9) < cfg.cooldown_s:
+                vetoed += 1
+            else:
+                cooled.append(g)
+        n = min(excess, cfg.max_moves_per_pass, len(cooled))
+        # Receivers by deficit, emptiest first; each receives up to its
+        # gap to fair share so one pass cannot overshoot into a new
+        # skew (the flap the cooldown alone would only slow down).
+        moves: List[Move] = []
+        receivers = sorted(
+            (m for m in balance if m != donor),
+            key=lambda m: balance[m])
+        gi = 0
+        for to in receivers:
+            room = max(int(view["fair"] + 0.5) - balance[to], 0)
+            while room > 0 and gi < n:
+                g = cooled[gi]
+                moves.append(Move(group=g, frm=donor, to=to,
+                                  reason=reason_of.get(g, "fill")))
+                gi += 1
+                room -= 1
+                balance[to] += 1
+        return moves, vetoed
+
+    # -- act -------------------------------------------------------------------
+
+    def run_once(self) -> Dict:
+        cfg = self.cfg
+        view = self.observe()
+        moves, vetoed = self.plan(view)
+        t0 = time.monotonic()
+        # One actuator call per (donor, receiver) pair and retry round:
+        # the transfer op takes a group list, and a 1024-group pass
+        # must not serialize into a thousand waited round trips.
+        by_pair: Dict[Tuple[int, int], List[Move]] = {}
+        for mv in moves:
+            by_pair.setdefault((mv.frm, mv.to), []).append(mv)
+        for (frm, to), batch in by_pair.items():
+            for _ in range(cfg.max_retries):
+                todo = [mv for mv in batch if not mv.ok]
+                if not todo:
+                    break
+                for mv in todo:
+                    mv.attempts += 1
+                done, _pending = self.act.transfer(
+                    frm, [mv.group for mv in todo], to,
+                    cfg.transfer_wait_s)
+                done_set = set(done)
+                for mv in todo:
+                    mv.ok = mv.group in done_set
+        for mv in moves:
+            # Cooldown stamps even failed attempts: a group that will
+            # not transfer must not be hammered pass after pass.
+            self._last_move[mv.group] = self._clock()
+        after = view
+        if moves:
+            # A completed transfer means the donor STOPPED leading; the
+            # transferee's TimeoutNow election lands a few rounds
+            # later. Let the census recover its pre-move leader total
+            # before judging convergence, or the ratio is computed
+            # over mid-election holes.
+            deadline = time.monotonic() + max(cfg.transfer_wait_s, 1.0)
+            total = sum(view["balance"].values())
+            while True:
+                after = self.observe()
+                if (sum(after["balance"].values()) >= total
+                        or time.monotonic() > deadline):
+                    break
+                time.sleep(0.2)
+        report = {
+            "triggered": bool(moves) or view["ratio"] > cfg.skew_ratio
+            or view["fresh_skew"],
+            "ratio_before": round(view["ratio"], 3),
+            "ratio_after": round(after["ratio"], 3),
+            "balance_before": view["balance"],
+            "balance_after": after["balance"],
+            "moves": [vars(mv) for mv in moves],
+            "moved": sum(1 for mv in moves if mv.ok),
+            "failed": sum(1 for mv in moves if not mv.ok),
+            "cooldown_vetoed": vetoed,
+            "move_wall_s": round(time.monotonic() - t0, 3),
+            "members_seen": after["members_seen"],
+            # Zero reachable rollups is an observability outage, not a
+            # balanced cluster — never report it as convergence.
+            "converged": (after["members_seen"] > 0
+                          and after["ratio"] <= cfg.skew_ratio),
+        }
+        if moves:
+            _log.info(
+                "rebalance pass: %d/%d moves ok, ratio %.2f -> %.2f",
+                report["moved"], len(moves), view["ratio"],
+                after["ratio"])
+        return report
+
+    def run_forever(self, interval: float = 5.0,
+                    on_report=None) -> None:
+        while True:
+            rep = self.run_once()
+            if on_report is not None:
+                on_report(rep)
+            time.sleep(interval)

@@ -159,7 +159,13 @@ class BatchedState(NamedTuple):
     # Membership (ref: tracker.Config / quorum/joint.go): incoming
     # voters, outgoing voters (joint), learners. in_joint gates the
     # second quorum half. Masks are uploaded by the host at the
-    # confchange apply point (SURVEY §2.1 "host-side control plane").
+    # confchange apply point (SURVEY §2.1 "host-side control plane"):
+    # on the hosting path that is batched/membership.GroupConfStore —
+    # committed EntryConfChangeV2 entries flip these lanes via one
+    # bulk staged upload (rawnode.set_membership_many), enter-joint at
+    # the joint entry's apply, auto-leave once the joint config
+    # commits. voter_out nonzero while in_joint is false is illegal
+    # (kernels.invariant_bits bit 8, voter_out_no_joint).
     voter: jnp.ndarray  # [N, R] bool
     voter_out: jnp.ndarray  # [N, R] bool (only meaningful when in_joint)
     learner: jnp.ndarray  # [N, R] bool

@@ -1224,6 +1224,11 @@ def _telemetry_frame(cfg: BatchedConfig, slot, pre: BatchedState,
         (post.read_ready & ~pre.read_ready).astype(I32),
         jnp.maximum(jnp.maximum(n_new, 0) - appended, 0),
         post.fenced.astype(I32),
+        # conf_changes_applied: always zero on device — entry types
+        # live in the host arena, so the rawnode adds the count where
+        # the masks are actually staged (advance_round's pending-conf
+        # application), keeping the column's per-round per-group shape.
+        jnp.zeros((), I32),
     )
     counters = jnp.stack([jnp.asarray(c, I32) for c in cols])
     assert counters.shape == (NUM_COUNTERS,)

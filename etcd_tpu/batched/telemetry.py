@@ -68,6 +68,13 @@ TM_NAMES = (
     "reads_confirmed",     # ReadIndex batches quorum-confirmed
     "proposals_dropped",   # staged proposals the device did not append
     "fenced_rounds",       # rounds spent durability-fenced (PAR rejoin)
+    # Membership-mask applications staged onto the device this round
+    # (entry-driven conf-change applies, snapshot conf restores, manual
+    # uploads). The device column is zero — entry types never reach the
+    # kernel — and the rawnode adds the count at the staging seam
+    # (advance_round's pending-conf application), so the flight
+    # recorder still shows per-group conf flips round by round.
+    "conf_changes_applied",
 )
 NUM_COUNTERS = len(TM_NAMES)
 TM_INDEX = {n: i for i, n in enumerate(TM_NAMES)}
@@ -85,6 +92,8 @@ INV_NAMES = (
     "snapshot_stuck",       # SNAPSHOT state with pending <= match
     "read_ready_no_batch",  # confirmed read with no batch open
     "fenced_leader",        # durability-fenced instance became leader
+    "voter_out_no_joint",   # outgoing-voter mask residue while the
+    # row is not in a joint config (conf-apply lane inconsistency)
 )
 
 
@@ -153,6 +162,34 @@ def fenced_groups_gauge(
         "etcd_tpu_batched_fenced_groups",
         "groups currently fenced out of elections after durable-loss "
         "detection (protocol-aware torn-tail recovery)",
+        ("member",),
+    ))
+
+
+def joint_groups_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    """Per-member count of groups currently inside a joint membership
+    config (between the enter-joint entry's apply and the leave-joint
+    commit). Set by the hosting layer's conf-apply path — a value stuck
+    above zero means auto-leave never fired (the condition
+    check_config_safety's 'joint always exited' clause asserts away)."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_batched_joint_groups",
+        "groups currently in a joint (two-quorum) membership config",
+        ("member",),
+    ))
+
+
+def learner_slots_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    """Per-member count of (group, slot) learner entries in the live
+    config — the catch-up population the promote gate watches."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_batched_learner_slots",
+        "live (group, slot) learner entries across this member's "
+        "group configs",
         ("member",),
     ))
 

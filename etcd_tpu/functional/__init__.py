@@ -10,6 +10,7 @@ failpoints, and the tester loop is `run_case`.
 
 from .cluster import Cluster
 from .checker import (
+    check_config_safety,
     check_leader_claims,
     check_sequential_history,
     committed_never_lost,
@@ -26,4 +27,5 @@ __all__ = [
     "hash_check", "lease_expire_check", "linearizable_check",
     "kv_map_hash", "multiraft_hash_check", "committed_never_lost",
     "check_leader_claims", "check_sequential_history",
+    "check_config_safety",
 ]

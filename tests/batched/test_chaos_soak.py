@@ -19,6 +19,7 @@ from etcd_tpu.batched.faults import (
     run_invariant_checks,
 )
 from etcd_tpu.batched.state import BatchedConfig
+from etcd_tpu.functional import check_config_safety
 from etcd_tpu.pkg import failpoint
 
 pytestmark = [pytest.mark.chaos, pytest.mark.slow]
@@ -164,6 +165,136 @@ class TestChaosMatrix:
                 f"fences never lifted: {m.health()}")
             h.plan.quiesce()
             full_check(h, obs)
+        finally:
+            obs.stop()
+            h.stop()
+
+
+# -- conf-change-under-fault cells (ISSUE 11) ----------------------------------
+#
+# Membership churn CONCURRENT with each fault class — the classic place
+# real multi-raft systems break (ROADMAP item 5). Every cell drives the
+# full migration cycle on a batch of groups (joint-implicit remove →
+# add-as-learner → catch-up-gated promote, auto-leave exiting every
+# joint config) while the fault plane fires, then closes at the same
+# strict bar as the base matrix: all three checkers, zero on-device
+# invariant trips (bit 8 voter_out_no_joint armed via CFG telemetry),
+# PLUS check_config_safety (committed configs never lost, adjacent
+# configs always share a quorum, joint always exited).
+
+CHURN_GROUPS = range(16)  # churned subset; the other 48 groups keep
+# serving the workload on the full electorate throughout
+
+
+def _churn_cell(h: ChaosHarness, obs: LeaderObserver,
+                fault_phase) -> None:
+    """Shared cell body: workload → (faults + churn concurrent) →
+    heal → restore full membership → strict close + config safety."""
+    h.wait_leaders()
+    obs.start()
+    h.run_workload(15, prefix=b"pre", per_put_timeout=15.0)
+    victim = 3  # churned member; fault victims are chosen per phase
+
+    def dwell():
+        fault_phase()
+        h.run_workload(10, prefix=b"dwell", per_put_timeout=20.0)
+
+    h.churn_member(victim, groups=CHURN_GROUPS,
+                   timeout_each=180.0, dwell=dwell)
+    h.plan.quiesce()
+    h.run_workload(8, prefix=b"post", per_put_timeout=15.0)
+    h.touch_all_groups(per_put_timeout=20.0)
+    full_check(h, obs)
+    check_config_safety(h.alive(), timeout=60.0)
+    # The churn really happened: joint configs entered and exited on
+    # the churned groups, and every group ended at full membership.
+    snap = h.members[1].conf_snapshot()
+    assert all(v == (1, 2, 3) for v in snap["voters"]), snap["voters"]
+    assert any(e["joint"] for g in CHURN_GROUPS
+               for e in h.members[1].conf_history(g))
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+class TestConfChurnMatrix:
+    def test_churn_under_message_faults_and_partition(self, tmp_path,
+                                                      transport):
+        """Lossy/reordering links + a symmetric partition episode
+        while the churned member is mid-cycle."""
+        seed = SEEDS[0]
+        h = ChaosHarness(str(tmp_path), seed, SOAK_FAULTS,
+                         num_members=R, num_groups=G, cfg=CFG,
+                         transport=transport)
+        obs = LeaderObserver(h.alive)
+
+        def fault_phase():
+            # Partition a NON-churned member mid-dwell, heal after the
+            # dwell workload has fought through it.
+            h.plan.partition(1, 2)
+            h.run_workload(6, prefix=b"cut", per_put_timeout=20.0)
+            h.plan.heal_all()
+
+        try:
+            _churn_cell(h, obs, fault_phase)
+            assert h.fabric.stats().get("dropped", 0) > 0
+        finally:
+            obs.stop()
+            h.stop()
+
+    def test_churn_under_crash_restart(self, tmp_path, transport):
+        """Kill -9 a NON-churned member at a storage failpoint while
+        the churned member is out of the config, restart it through
+        _replay mid-cycle — the restarted member must reconstruct the
+        conf state it crashed holding (RT_CONF_BATCH + committed-entry
+        re-apply) before rejoining the churn quorum."""
+        seed = SEEDS[1 % len(SEEDS)]
+        h = ChaosHarness(str(tmp_path), seed,
+                         FaultSpec(drop=0.03, delay=0.05,
+                                   delay_max_s=0.03),
+                         num_members=R, num_groups=G, cfg=CFG,
+                         transport=transport)
+        obs = LeaderObserver(h.alive)
+        site = ("before_save" if transport == "inproc"
+                else "after_save")
+
+        def fault_phase():
+            h.crash_on_failpoint(2, site, timeout=60.0)
+            h.run_workload(6, prefix=b"down", per_put_timeout=25.0)
+            h.restart(2)
+            h.wait_leaders(timeout=120.0)
+
+        try:
+            _churn_cell(h, obs, fault_phase)
+        finally:
+            obs.stop()
+            h.stop()
+
+    def test_churn_under_torn_tail(self, tmp_path, transport):
+        """Crash + torn WAL tail on the CHURNED member while it is out
+        of the churned groups' configs: it boots FENCED for whatever
+        the tear damaged, heals through the probe/snapshot path, and
+        is then re-admitted (learner → gate → promote) into groups
+        whose quorum kept serving — closing strict with every joint
+        exited. (Tearing a NON-churned member here would be a designed
+        unavailability, not a robustness gap: the churned groups run a
+        two-voter config mid-cycle, and a two-voter group has zero
+        fault tolerance — fencing one of its voters makes elections
+        impossible by construction until catch-up, which itself needs
+        a leader.)"""
+        seed = SEEDS[2 % len(SEEDS)]
+        h = ChaosHarness(str(tmp_path), seed, FaultSpec(),
+                         num_members=R, num_groups=G, cfg=CFG,
+                         transport=transport)
+        obs = LeaderObserver(h.alive)
+
+        def fault_phase():
+            h.crash(3)
+            h.torn_tail(3, max_chop=48)
+            h.run_workload(6, prefix=b"torn", per_put_timeout=25.0)
+            h.restart(3)
+            h.wait_leaders(timeout=120.0)
+
+        try:
+            _churn_cell(h, obs, fault_phase)
         finally:
             obs.stop()
             h.stop()

@@ -1,0 +1,453 @@
+"""Batched joint-consensus membership changes (ISSUE 11): entry-driven
+conf changes on the hosting path, the full migration cycle, and the
+config-safety checker — deterministic, in tier-1.
+
+The flow is ROADMAP item 5's success bar at tier-1 scale: remove a
+member everywhere (joint-implicit change: enter-joint at the entry's
+apply, auto-leave once the joint config commits), run the cluster on
+the shrunk electorate while the removed member's frames drop at the
+fabric (decommissioned ≠ slow), then re-admit it — add-as-learner →
+snapshot-rejoin for the groups whose log floor moved past it →
+catch-up-gated promote — and close with the strict three chaos
+checkers plus check_config_safety.
+
+Shares test_chaos.py's config value-for-value: _step_round_jit caches
+the compiled round per config VALUE, so this module adds NO round-step
+compile (tier-1 budget unchanged at tests/batched/conftest.py's
+declared shapes).
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from etcd_tpu.batched.faults import (
+    ChaosHarness,
+    FaultPlan,
+    FaultSpec,
+    FaultyFabric,
+    LeaderObserver,
+    run_invariant_checks,
+)
+from etcd_tpu.batched.kernels import invariant_bits
+from etcd_tpu.batched.membership import GroupConfStore
+from etcd_tpu.batched.state import BatchedConfig
+from etcd_tpu.batched.telemetry import INV_NAMES, decode_invariants
+from etcd_tpu.functional import check_config_safety
+
+pytestmark = pytest.mark.chaos
+
+G, R = 8, 3
+SEED = 101
+# Value-identical to tests/batched/test_chaos.py CFG (one compile).
+CFG = BatchedConfig(
+    num_groups=G, num_replicas=R, window=16, max_ents_per_msg=4,
+    max_props_per_round=4, election_timeout=10, heartbeat_timeout=1,
+    pre_vote=True, check_quorum=True, auto_compact=True,
+    fleet_summary=True,
+)
+
+
+def make_harness(tmp_path):
+    return ChaosHarness(
+        str(tmp_path), SEED, FaultSpec(), num_members=R, num_groups=G,
+        cfg=CFG, transport="inproc",
+    )
+
+
+class TestMembershipCycle:
+    def test_remove_readd_promote_strict(self, tmp_path):
+        """The migration cycle across 3 members: joint remove member 3
+        everywhere → quorum-of-2 service with the removed member's
+        frames dropping at the fabric → re-add as learner (snapshot
+        rejoin where compaction passed it) → catch-up-gated promote →
+        strict 3-checker close + config safety."""
+        h = make_harness(tmp_path)
+        obs = LeaderObserver(h.alive)
+        try:
+            h.wait_leaders()
+            obs.start()
+            assert h.run_workload(6, prefix=b"pre") == 6
+
+            # -- decommission member 3 everywhere (joint-implicit) ----
+            h.reconfig_until("remove", 3, timeout=90.0, joint=True)
+            h.mark_removed(3)
+            # reconfig_until waits on each group's LEADER; the other
+            # surviving voter applies the same entries as its commit
+            # watermark catches up.
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                snaps = [m.conf_snapshot()
+                         for m in (h.members[1], h.members[2])]
+                if all(all(v == (1, 2) for v in s["voters"])
+                       and not s["in_joint"].any() for s in snaps):
+                    break
+                time.sleep(0.05)
+            for s in snaps:
+                assert all(v == (1, 2) for v in s["voters"]), s["voters"]
+                assert not s["in_joint"].any()
+
+            # Quorum {1,2} keeps serving; deep-write two groups so
+            # auto-compaction moves their floors past member 3's log —
+            # its re-admission must take the snapshot-rejoin path.
+            for i in range(CFG.window):
+                assert h.put(0, b"deep0-%d" % i, b"dv%d" % i)
+                assert h.put(1, b"deep1-%d" % i, b"dv%d" % i)
+            assert h.run_workload(4, prefix=b"mid") == 4
+
+            # -- re-admit: learner -> catch up -> promote -------------
+            h.mark_rejoined(3)
+            h.reconfig_until("add-learner", 3, timeout=90.0)
+            h.reconfig_until("promote", 3, timeout=120.0, joint=True)
+
+            # Snapshot rejoin actually happened for the deep groups:
+            # member 3's applied watermark reached past the entries it
+            # never received as a removed voter.
+            deadline = time.monotonic() + 60.0
+            m3 = h.members[3]
+            while time.monotonic() < deadline:
+                if (m3.applied_index[0] >= CFG.window
+                        and m3.applied_index[1] >= CFG.window):
+                    break
+                time.sleep(0.05)
+            assert m3.applied_index[0] >= CFG.window, (
+                int(m3.applied_index[0]))
+
+            assert h.run_workload(4, prefix=b"post") == 4
+            h.touch_all_groups()
+            run_invariant_checks(h, obs, expect_members=R)
+            check_config_safety(h.alive())
+
+            # Joint configs were entered AND exited along the way.
+            hist = h.members[1].conf_history(0)
+            assert any(e["joint"] for e in hist), hist
+            assert not h.members[1].conf.in_joint.any()
+            assert h.members[1].conf.epoch.sum() > 0
+            # The live census gauges returned to quiet.
+            health = h.members[1].health()
+            assert health["joint_groups"] == 0
+            assert health["learner_slots"] == 0
+            assert health["conf_applied"] > 0
+        finally:
+            obs.stop()
+            h.stop()
+
+    def test_conf_state_survives_crash_replay(self, tmp_path):
+        """WAL reconstruction (RT_CONF_BATCH + committed-entry
+        re-apply): demote a member to learner, kill -9 another member,
+        and the restarted member must boot with the SAME config it
+        applied before the crash — then promote back and close strict."""
+        h = make_harness(tmp_path)
+        obs = LeaderObserver(h.alive)
+        try:
+            h.wait_leaders()
+            obs.start()
+            assert h.run_workload(4, prefix=b"pre") == 4
+            h.reconfig_until("add-learner", 3, timeout=90.0)
+            # Let the demotion reach every member's apply (the crash
+            # victim must have something to replay).
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if all(m.conf.learner[:, 2].all() for m in h.alive()):
+                    break
+                time.sleep(0.05)
+            pre = h.members[2].conf_snapshot()
+            assert all(lr == (3,) for lr in pre["learners"]), pre
+
+            h.crash(2)
+            m2 = h.restart(2)
+            post = m2.conf_snapshot()
+            assert post["voters"] == pre["voters"]
+            assert post["learners"] == pre["learners"]
+            h.wait_leaders()
+
+            h.reconfig_until("promote", 3, timeout=120.0, joint=True)
+            h.run_workload(3, prefix=b"post")
+            h.touch_all_groups()
+            run_invariant_checks(h, obs, expect_members=R)
+            check_config_safety(h.alive())
+        finally:
+            obs.stop()
+            h.stop()
+
+
+class TestAdminReconfigOps:
+    def test_reconfig_conf_and_transfer_wait_ops(self, tmp_path):
+        """The hosting_proc admin surface (satellite): 'reconfig' with
+        per-group results, 'conf' rollup, and 'transfer' with bounded
+        wait-for-completion — driven through real AdminServer sockets
+        around an in-proc cluster (same config, no extra compile)."""
+        from etcd_tpu.batched.hosting import MultiRaftCluster
+        from etcd_tpu.batched.hosting_proc import (
+            AdminServer,
+            ProcClient,
+        )
+
+        cluster = MultiRaftCluster(str(tmp_path), num_members=R,
+                                   num_groups=G, cfg=CFG)
+        admins, clients = [], {}
+        try:
+            cluster.wait_leaders()
+            for m in cluster.members.values():
+                srv = AdminServer(m, cluster.router, ("127.0.0.1", 0))
+                admins.append(srv)
+                clients[m.id] = ProcClient(("127.0.0.1", srv.addr[1]))
+
+            # Demote member 3 to learner through the admin op; per-
+            # group results split exactly into ok (groups this member
+            # leads) and not-leader redirects.
+            per_member = {}
+            for mid, c in clients.items():
+                r = c.call(op="reconfig", action="add-learner",
+                           member=3, groups=list(range(G)))
+                assert r["ok"], r
+                assert set(r["results"].values()) <= {
+                    "ok", "not-leader", "not-learner"}, r
+                per_member[mid] = r
+            assert sum(r["proposed"] for r in per_member.values()) > 0
+
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                conf = clients[1].call(op="conf")
+                if all(lr == [3] for lr in conf["learners"]):
+                    break
+                time.sleep(0.1)
+            assert conf["ok"]
+            assert all(lr == [3] for lr in conf["learners"]), conf
+            assert all(v == [1, 2] for v in conf["voters"])
+            assert conf["in_joint"] == [0] * G
+
+            # Promote back (gated) until every group reports voter 3.
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                for c in clients.values():
+                    c.call(op="reconfig", action="promote", member=3,
+                           groups=list(range(G)))
+                conf = clients[1].call(op="conf")
+                if all(v == [1, 2, 3] for v in conf["voters"]):
+                    break
+                time.sleep(0.5)
+            assert all(v == [1, 2, 3] for v in conf["voters"]), conf
+
+            # Bounded-wait transfer: whatever member 1 leads moves to
+            # member 2, and the op only returns groups as done once
+            # member 1 actually stopped leading them.
+            own = [g for g in range(G)
+                   if cluster.members[1].is_leader(g)]
+            r = clients[1].call(op="transfer", to=2, groups=own,
+                                wait_s=20.0)
+            assert r["ok"] and r["moved"] == len(own)
+            assert sorted(r["done"] + r["pending"]) == sorted(own)
+            for g in r["done"]:
+                assert not cluster.members[1].is_leader(g)
+            # Bad targets refuse loudly.
+            assert "err" in clients[1].call(op="reconfig",
+                                            action="promote",
+                                            member=99, groups=[0])
+            assert "err" in clients[1].call(op="reconfig",
+                                            action="bogus",
+                                            member=2, groups=[0])
+        finally:
+            for c in clients.values():
+                c.close()
+            for a in admins:
+                a.close()
+            cluster.stop()
+
+
+class TestRemovedMemberFabric:
+    """Satellite fix: the delayed-delivery pump and incarnation tokens
+    treat a config-removed member like a crashed incarnation."""
+
+    def test_frames_to_removed_member_drop_and_count(self):
+        plan = FaultPlan(7, FaultSpec())
+        tokens = {2: object()}
+        removed = set()
+        fab = FaultyFabric(
+            plan,
+            incarnation_fn=lambda d: (None if d in removed
+                                      else tokens.get(d)),
+            removed_fn=lambda d: d in removed)
+        hits = []
+        try:
+            # Live member: immediate path delivers.
+            fab._ship(1, 2, lambda: hits.append("a"), 1)
+            assert hits == ["a"]
+            # Removed member: immediate path drops and counts.
+            removed.add(2)
+            fab._ship(1, 2, lambda: hits.append("b"), 3)
+            assert hits == ["a"]
+            assert fab.stats().get("removed_drop") == 3
+            # Delayed path: enqueue against a LIVE member, remove it
+            # before the frame fires — the fire-time token check drops.
+            removed.discard(2)
+            fab._later(0.15, 2, 2, lambda: hits.append("c"))
+            removed.add(2)
+            time.sleep(0.4)
+            assert hits == ["a"]
+            assert fab.stats().get("removed_drop") == 5
+        finally:
+            fab.stop()
+
+    def test_predecessor_frames_never_leak_into_readded_member(self):
+        plan = FaultPlan(8, FaultSpec())
+        tokens = {2: object()}
+        removed = set()
+        fab = FaultyFabric(
+            plan,
+            incarnation_fn=lambda d: (None if d in removed
+                                      else tokens.get(d)),
+            removed_fn=lambda d: d in removed)
+        hits = []
+        try:
+            # Enqueued against the PRE-removal incarnation...
+            fab._later(0.15, 2, 1, lambda: hits.append("old"))
+            removed.add(2)
+            # ...then the member is re-admitted under a NEW token
+            # (ChaosHarness.mark_rejoined mints one) before the frame
+            # fires: the stale frame must drop, not land in the
+            # successor.
+            tokens[2] = object()
+            removed.discard(2)
+            time.sleep(0.4)
+            assert hits == []
+            stats = fab.stats()
+            assert (stats.get("removed_drop", 0)
+                    + stats.get("crashed_drop", 0)) == 1, stats
+            # The successor itself still receives fresh traffic.
+            fab._ship(1, 2, lambda: hits.append("new"), 1)
+            assert hits == ["new"]
+        finally:
+            fab.stop()
+
+
+class TestInvariantBit:
+    def test_voter_out_without_joint_trips_bit(self):
+        """invariant_bits bit 8 (INV_NAMES voter_out_no_joint): a
+        nonzero outgoing-voter row with in_joint false is an illegal
+        conf-apply state. Pure per-instance kernel math — no round
+        program, no compile."""
+        r = 3
+
+        class St:
+            pass
+
+        st = St()
+        st.match = jnp.zeros((r,), jnp.int32)
+        st.next = jnp.ones((r,), jnp.int32)
+        st.pr_state = jnp.zeros((r,), jnp.int32)
+        st.probe_sent = jnp.zeros((r,), bool)
+        st.pending_snapshot = jnp.zeros((r,), jnp.int32)
+        st.voter = jnp.asarray([True, True, False])
+        st.voter_out = jnp.zeros((r,), bool)
+        st.learner = jnp.zeros((r,), bool)
+        st.in_joint = jnp.asarray(False)
+        st.fenced = jnp.asarray(False)
+        st.role = jnp.asarray(0, jnp.int32)
+        st.lead = jnp.asarray(0, jnp.int32)
+        st.commit = jnp.asarray(0, jnp.int32)
+        st.last = jnp.asarray(0, jnp.int32)
+        st.snap_index = jnp.asarray(0, jnp.int32)
+        st.read_ready = jnp.asarray(False)
+        st.read_index = jnp.asarray(0, jnp.int32)
+        slot = jnp.asarray(0, jnp.int32)
+        assert int(invariant_bits(st, slot)) == 0
+
+        st.voter_out = jnp.asarray([True, True, False])
+        bits = int(invariant_bits(st, slot))
+        assert decode_invariants(bits) == ["voter_out_no_joint"]
+        # ...and the same masks are legal while joint.
+        st.in_joint = jnp.asarray(True)
+        assert int(invariant_bits(st, slot)) == 0
+        assert "voter_out_no_joint" in INV_NAMES
+
+
+class TestConfStoreSemantics:
+    """Reference joint-consensus semantics on the mask-native store
+    (no jax, no compile)."""
+
+    def test_joint_cycle_and_history(self):
+        from etcd_tpu.raft.types import (
+            ConfChangeSingle,
+            ConfChangeTransition,
+            ConfChangeType,
+            ConfChangeV2,
+        )
+
+        cs = GroupConfStore(2, 3)
+        jrm = ConfChangeV2(
+            transition=(ConfChangeTransition
+                        .ConfChangeTransitionJointImplicit),
+            changes=[ConfChangeSingle(
+                ConfChangeType.ConfChangeRemoveNode, 3)])
+        assert cs.apply(0, 4, jrm) is None
+        assert cs.in_joint[0] and cs.auto_leave[0]
+        assert tuple(np.nonzero(cs.voter_out[0])[0] + 1) == (1, 2, 3)
+        assert tuple(np.nonzero(cs.voter[0])[0] + 1) == (1, 2)
+        # Mid-joint second change refuses deterministically.
+        assert cs.apply(0, 5, jrm) == "already in a joint config"
+        # ...and so does a SIMPLE change (a stale duplicate applying
+        # inside someone else's joint window must not edit the
+        # incoming half behind the outgoing snapshot's back).
+        simple = ConfChangeV2(changes=[ConfChangeSingle(
+            ConfChangeType.ConfChangeAddLearnerNode, 1)])
+        assert "joint" in cs.apply(0, 6, simple)
+        assert cs.voter[0, 0] and not cs.learner[0, 0]
+        # Leave-joint (the auto-proposed empty change).
+        assert cs.apply(0, 7, ConfChangeV2()) is None
+        assert not cs.in_joint[0] and not cs.voter_out[0].any()
+        # Replay idempotence: the same indexes skip as stale.
+        assert cs.apply(0, 7, ConfChangeV2()) == "stale"
+        # History carries the joint entry and its exit.
+        hist = cs.history(0)
+        assert [e["joint"] for e in hist] == [True, False]
+
+    def test_demotion_parks_in_learner_next_until_leave(self):
+        from etcd_tpu.raft.types import (
+            ConfChangeSingle,
+            ConfChangeTransition,
+            ConfChangeType,
+            ConfChangeV2,
+        )
+
+        cs = GroupConfStore(1, 3)
+        demote = ConfChangeV2(
+            transition=(ConfChangeTransition
+                        .ConfChangeTransitionJointImplicit),
+            changes=[ConfChangeSingle(
+                ConfChangeType.ConfChangeAddLearnerNode, 2)])
+        assert cs.apply(0, 3, demote) is None
+        # While joint: outgoing voter, not yet a learner (its old-half
+        # vote still counts) — the reference's learners_next.
+        assert not cs.voter[0, 1] and not cs.learner[0, 1]
+        assert cs.learner_next[0, 1] and cs.voter_out[0, 1]
+        assert cs.apply(0, 4, ConfChangeV2()) is None
+        assert cs.learner[0, 1] and not cs.learner_next[0, 1]
+
+    def test_wal_roundtrip_and_restore(self):
+        from etcd_tpu.raft.types import (
+            ConfChangeSingle,
+            ConfChangeType,
+            ConfChangeV2,
+            ConfState,
+        )
+
+        cs = GroupConfStore(3, 3)
+        cc = ConfChangeV2(changes=[ConfChangeSingle(
+            ConfChangeType.ConfChangeAddLearnerNode, 3)])
+        assert cs.apply(1, 9, cc) is None
+        blob = cs.pack_groups(np.asarray([1]))
+        cs2 = GroupConfStore(3, 3)
+        for g, idx, flags, slots in GroupConfStore.unpack_groups(
+                blob, 3):
+            cs2.load_record(g, idx, flags, slots)
+        assert (cs2.learner[1] == cs.learner[1]).all()
+        assert cs2.applied_index[1] == 9
+        # Snapshot restore: carried ConfState supersedes, marks the
+        # history entry as an adjacency re-anchor.
+        assert cs2.restore(2, 20, ConfState(voters=[1, 2],
+                                            learners=[3]))
+        assert cs2.history(2)[-1]["restored"]
+        assert not cs2.restore(2, 20, ConfState(voters=[1]))

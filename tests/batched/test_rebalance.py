@@ -1,0 +1,250 @@
+"""Rebalancer policy unit tests (ISSUE 11): fleet-signal-driven
+decisions, bounded retry, and — the acceptance bar — flap-proofing
+under an adversarial signal stream. Pure host (no jax, no compile):
+the actuator is faked, so every pathological rollup shape is
+constructible deterministically; the end-to-end loop against a real
+cluster lives in tools/rebalance_smoke.py (check.sh) and the admin
+path in tests/batched/test_hosting_proc.py.
+"""
+
+from typing import Dict, List, Tuple
+
+from etcd_tpu.batched.rebalance import (
+    Move,
+    RebalanceConfig,
+    Rebalancer,
+)
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class FakeActuator:
+    """Scriptable actuator: `balance` is mutated by successful
+    transfers unless `frozen` pins the reported rollups (the flapping
+    signal — the observatory keeps screaming skew no matter what the
+    daemon does)."""
+
+    def __init__(self, balance: Dict[int, int], groups: int,
+                 flagged=None, frozen: bool = False,
+                 transfer_ok: bool = True,
+                 bounce: bool = False) -> None:
+        self.balance = dict(balance)
+        self.reported = dict(balance)
+        self.groups = groups
+        self.flagged = flagged or []
+        self.frozen = frozen
+        self.transfer_ok = transfer_ok
+        # bounce: the transfer REPORTS done but leadership snaps back
+        # (elections under load) — the cluster state never changes,
+        # the observatory keeps screaming, and only the cooldown
+        # stands between the daemon and leadership churn.
+        self.bounce = bounce
+        self.transfers: List[Tuple[int, int, int]] = []
+        self.led: Dict[int, List[int]] = {}
+        # Donor leads groups 0..k-1 by default; others the rest.
+        nxt = 0
+        for mid in sorted(balance, key=lambda m: -balance[m]):
+            self.led[mid] = list(range(nxt, nxt + balance[mid]))
+            nxt += balance[mid]
+
+    def members(self) -> List[int]:
+        return sorted(self.balance)
+
+    def rollup(self, mid: int):
+        src = self.reported if self.frozen else self.balance
+        top = [{"group": g, "lag": 9} for g, why in self.flagged
+               if why == "laggard"]
+        log = [{"kind": "commit_frozen", "group": g}
+               for g, why in self.flagged if why == "commit_frozen"]
+        return {
+            "member": str(mid),
+            "groups": self.groups,
+            "leaders_total": src[mid],
+            "anomalies": {},
+            "anomaly_log": log if mid == self._donor() else [],
+            "top": top if mid == self._donor() else [],
+        }
+
+    def _donor(self) -> int:
+        return max(self.balance, key=lambda m: self.balance[m])
+
+    def led_groups(self, mid: int) -> List[int]:
+        return list(self.led.get(mid, []))
+
+    def transfer(self, mid: int, groups: List[int], to: int,
+                 wait_s: float) -> Tuple[List[int], List[int]]:
+        self.transfers.extend((mid, g, to) for g in groups)
+        if not self.transfer_ok:
+            return [], list(groups)
+        if self.bounce:
+            return list(groups), []
+        for g in groups:
+            if g in self.led.get(mid, []):
+                self.led[mid].remove(g)
+                self.led.setdefault(to, []).append(g)
+                self.balance[mid] -= 1
+                self.balance[to] = self.balance.get(to, 0) + 1
+        return list(groups), []
+
+
+CFG = RebalanceConfig(skew_ratio=1.5, cooldown_s=30.0,
+                      max_moves_per_pass=16, max_retries=3,
+                      transfer_wait_s=0.0, min_groups=8)
+
+
+def test_skew_triggers_and_converges_in_one_pass():
+    act = FakeActuator({1: 24, 2: 0, 3: 0}, groups=24)
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    assert rep["triggered"] and rep["converged"]
+    assert rep["ratio_before"] == 3.0
+    assert rep["moved"] == 16  # capped by max_moves_per_pass
+    assert rep["failed"] == 0
+    # Receivers filled toward fair share, emptiest first, never past
+    # fair — one pass must not overshoot into a NEW skew.
+    assert act.balance[1] == 8
+    assert act.balance[2] == 8 and act.balance[3] == 8
+    assert rep["ratio_after"] == 1.0
+
+
+def test_balanced_cluster_never_triggers():
+    act = FakeActuator({1: 8, 2: 8, 3: 8}, groups=24)
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    assert not rep["triggered"]
+    assert rep["moves"] == [] and act.transfers == []
+
+
+def test_tiny_cluster_below_min_groups_never_triggers():
+    act = FakeActuator({1: 4, 2: 0, 3: 0}, groups=4)
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    assert rep["moves"] == [] and act.transfers == []
+
+
+def test_observatory_flagged_groups_move_first():
+    """commit_frozen + top-K laggard ids choose which groups move
+    first (the ISSUE's priority contract)."""
+    act = FakeActuator({1: 24, 2: 0, 3: 0}, groups=24,
+                       flagged=[(17, "commit_frozen"), (5, "laggard")])
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    first_two = [mv["group"] for mv in rep["moves"][:2]]
+    assert first_two == [17, 5]
+    assert rep["moves"][0]["reason"] == "commit_frozen"
+    assert rep["moves"][1]["reason"] == "laggard"
+
+
+def test_flap_injection_cooldown_bounds_moves():
+    """THE flap test: the observatory signal is stuck (rollups report
+    the seeded skew forever, whatever the daemon does). Back-to-back
+    passes must not re-move quarantined groups — the per-group
+    cooldown plus the per-pass cap bound total churn to one pass's
+    worth until the cooldown expires."""
+    clock = FakeClock()
+    act = FakeActuator({1: 24, 2: 0, 3: 0}, groups=24, bounce=True)
+    reb = Rebalancer(act, CFG, clock=clock)
+    rep1 = reb.run_once()
+    assert rep1["moved"] == 16
+    moved_once = {mv["group"] for mv in rep1["moves"]}
+
+    # Hammer the daemon inside the cooldown window: the signal still
+    # screams skew, but every already-moved group is quarantined.
+    total_extra = 0
+    for _ in range(5):
+        clock.t += 1.0
+        rep = reb.run_once()
+        for mv in rep["moves"]:
+            assert mv["group"] not in moved_once, (
+                f"group {mv['group']} re-moved inside cooldown")
+            moved_once.add(mv["group"])
+        total_extra += rep["moved"]
+        assert rep["cooldown_vetoed"] > 0
+    # Bounded: only the 8 never-moved donor groups were eligible —
+    # churn is one pass's worth, not 5x, however loud the signal.
+    assert total_extra <= 8
+
+    # After the cooldown expires the daemon may act again (it is a
+    # quarantine, not a permanent blacklist).
+    clock.t += CFG.cooldown_s + 1.0
+    rep = reb.run_once()
+    assert rep["moved"] > 0
+
+
+def test_failed_transfers_retry_bounded_then_give_up():
+    act = FakeActuator({1: 24, 2: 0, 3: 0}, groups=24,
+                       transfer_ok=False)
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    assert rep["moved"] == 0 and rep["failed"] == 16
+    for mv in rep["moves"]:
+        assert mv["attempts"] == CFG.max_retries and not mv["ok"]
+    # Every attempt bounded: 16 moves x 3 retries, not an unbounded
+    # hammer.
+    assert len(act.transfers) == 16 * CFG.max_retries
+    # Failed groups are cooldown-stamped too: the next immediate pass
+    # must not re-hammer them.
+    rep2 = reb.run_once()
+    assert rep2["moved"] == 0
+    assert len(act.transfers) <= 16 * CFG.max_retries + 8 * CFG.max_retries
+
+
+def test_fresh_leader_skew_anomaly_triggers_below_ratio():
+    """The edge-triggered leader_skew flag fires a pass even when the
+    scraped ratio sits below the local threshold (the hub's threshold
+    may be tighter than the daemon's)."""
+    act = FakeActuator({1: 11, 2: 7, 3: 6}, groups=24)
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+
+    base = act.rollup(1)
+
+    def rollup_with_anomaly(mid):
+        r = dict(base, leaders_total=act.balance[mid])
+        if mid == 1:
+            r = dict(r, anomalies={"leader_skew": 1})
+        return r
+
+    act.rollup = rollup_with_anomaly  # type: ignore[assignment]
+    rep = reb.run_once()
+    assert rep["triggered"]
+    assert rep["moved"] > 0
+
+
+def test_total_scrape_outage_is_not_convergence():
+    """Zero reachable rollups must read as an observability outage
+    (converged=False, so rebalancerd --once exits nonzero), never as a
+    balanced cluster — ratio 0.0 over no data is vacuous."""
+    act = FakeActuator({1: 24, 2: 0, 3: 0}, groups=24)
+    act.rollup = lambda mid: None  # type: ignore[assignment]
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    assert rep["members_seen"] == 0
+    assert not rep["converged"]
+    assert rep["moves"] == []
+
+
+def test_report_schema_matches_rebalancerd_contract():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "rebalancerd", os.path.join(
+            os.path.dirname(__file__), "..", "..", "tools",
+            "rebalancerd.py"))
+    rbd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rbd)
+    act = FakeActuator({1: 24, 2: 0, 3: 0}, groups=24)
+    rep = Rebalancer(act, CFG, clock=FakeClock()).run_once()
+    assert rbd.validate_report(rep) == []
+
+
+def test_move_dataclass_shape():
+    mv = Move(group=1, frm=2, to=3)
+    assert vars(mv) == {"group": 1, "frm": 2, "to": 3, "attempts": 0,
+                        "ok": False, "reason": ""}

@@ -3,7 +3,9 @@
 # + runtime-sentinel smoke (transfer guard, recompile budget, lock
 # order) + trace smoke (one traced in-proc round, exporter validated)
 # + fleet smoke (tiny in-proc cluster with the fleet observatory on,
-# fleet_console --once --json validated) + bench-history re-emit. CI
+# fleet_console --once --json validated) + rebalance smoke (seeded
+# leader skew, rebalancerd --once --json must converge it) +
+# bench-history re-emit. CI
 # runs exactly this script
 # (.github/workflows/lint.yml); run it locally before pushing anything
 # that touches the batched hot path.
@@ -31,6 +33,9 @@ python tools/trace_smoke.py
 
 echo "== fleet smoke (in-proc cluster with fleet on, console --once --json) =="
 python tools/fleet_smoke.py
+
+echo "== rebalance smoke (seeded leader skew, rebalancerd --once --json) =="
+python tools/rebalance_smoke.py
 
 echo "== bench history (artifacts/bench_history.json + BENCH_HISTORY.md) =="
 python tools/bench_history.py

@@ -104,6 +104,14 @@ def collect(addrs: List[str], timeout: float = 10.0,
             "wal_tail": hl.get("wal_tail") if hl.get("ok") else None,
             "health_fenced": (len(hl.get("fenced_groups", []))
                               if hl.get("ok") else None),
+            # Membership control plane (ISSUE 11): live joint/learner
+            # census + applied conf-change total from the health op.
+            "joint": (hl.get("joint_groups", 0)
+                      if hl.get("ok") else None),
+            "learners": (hl.get("learner_slots", 0)
+                         if hl.get("ok") else None),
+            "conf_applied": (hl.get("conf_applied", 0)
+                             if hl.get("ok") else None),
             "router_loss": (_sum_numeric(st.get("router", {}))
                             if st.get("ok") else None),
         })
@@ -130,6 +138,14 @@ def collect(addrs: List[str], timeout: float = 10.0,
         "leader_balance": {m["member"]: m["leaders"] for m in live},
         "leaders_total": sum(m["leaders"] for m in live),
         "fenced_total": sum(m["fenced"] for m in live),
+        # Joint/learner censuses count the REPLICATED config, which
+        # every member holds a copy of — merge by max (summing would
+        # triple-count a converged cluster; a member lagging behind a
+        # conf apply under-reports, and max keeps the true census).
+        "joint_total": max((m.get("joint") or 0 for m in live),
+                           default=0),
+        "learners_total": max((m.get("learners") or 0 for m in live),
+                              default=0),
         "invariant_trips_total": (sum(trip_vals) if trip_vals
                                   else None),
         "router_loss_total": sum(m["router_loss"] or 0 for m in live),
@@ -151,7 +167,8 @@ def validate_rollup(data: Dict) -> List[str]:
             probs.append(f"missing key {key!r}")
     cl = data.get("cluster", {})
     for key in ("members_live", "leader_balance", "leaders_total",
-                "fenced_total", "top", "anomalies",
+                "fenced_total", "joint_total", "learners_total",
+                "top", "anomalies",
                 "invariant_trips_total", "router_loss_total"):
         if key not in cl:
             probs.append(f"cluster missing {key!r}")
@@ -178,11 +195,13 @@ def render(data: Dict, top: int = 8) -> str:
         f"members {cl['members_live']}/{cl['members_total']}  "
         f"groups {cl['groups']}  leaders {cl['leaders_total']}  "
         f"fenced {cl['fenced_total']}  "
+        f"joint {cl['joint_total']}  learners {cl['learners_total']}  "
         f"inv-trips "
         f"{'n/a' if cl['invariant_trips_total'] is None else cl['invariant_trips_total']}  "
         f"router-loss {cl['router_loss_total']}",
         "",
         f"{'member':>8} {'frames':>8} {'leaders':>8} {'fenced':>7} "
+        f"{'joint':>6} {'lrnr':>5} "
         f"{'lag max':>8} {'inv':>5} {'loss':>6}  wal tail / state",
     ]
     for mid in sorted(data["members"]):
@@ -192,7 +211,8 @@ def render(data: Dict, top: int = 8) -> str:
             continue
         lines.append(
             f"{m['member']:>8} {m['frames']:>8} {m['leaders']:>8} "
-            f"{m['fenced']:>7} {m['lag_max']:>8} "
+            f"{m['fenced']:>7} {str(m.get('joint')):>6} "
+            f"{str(m.get('learners')):>5} {m['lag_max']:>8} "
             f"{str(m['invariant_trips']):>5} "
             f"{str(m['router_loss']):>6}  {m['wal_tail']}")
     lines.append("")

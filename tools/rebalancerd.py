@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Rebalancer daemon over the hosting admin API (ISSUE 11, ROADMAP 5).
+
+Closes the fleet-observatory loop as a standalone process: scrapes
+every member's ``fleet`` rollup (the device SummaryFrame the members
+already emit — no bespoke probes), and when leadership skew crosses the
+threshold (the same quantity the ``leader_skew`` anomaly flags) moves
+donor-led groups to under-loaded members via the admin ``transfer`` op
+— observatory-flagged groups (``commit_frozen``, top-K laggards) first,
+each move awaited with a bounded timeout, retried at most
+``--max-retries`` times, and quarantined by a per-group cooldown so a
+noisy signal stream can never flap leadership.
+
+    python tools/rebalancerd.py --admin 1=127.0.0.1:8001 \
+        --admin 2=127.0.0.1:8002 --admin 3=127.0.0.1:8003
+
+``--once --json`` runs a single observe→move→re-observe pass and prints
+the machine-readable report (the scripting/CI contract —
+tools/rebalance_smoke.py validates it); exit code 0 means the cluster
+is at-or-below the skew threshold after the pass.
+
+Member ids: pass ``--admin id=host:port``; bare ``host:port`` entries
+are numbered 1..N in order.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPORT_KEYS = (
+    "triggered", "ratio_before", "ratio_after", "balance_before",
+    "balance_after", "moves", "moved", "failed", "cooldown_vetoed",
+    "members_seen", "converged",
+)
+
+
+def validate_report(rep: Dict) -> List[str]:
+    """Schema check for the --once --json contract; returns problems,
+    empty == valid."""
+    probs = [f"missing key {k!r}" for k in REPORT_KEYS if k not in rep]
+    for mv in rep.get("moves", ()):
+        for k in ("group", "frm", "to", "attempts", "ok"):
+            if k not in mv:
+                probs.append(f"move missing {k!r}: {mv}")
+    return probs
+
+
+def _parse_admins(specs: List[str]) -> Dict[int, Tuple[str, int]]:
+    addrs: Dict[int, Tuple[str, int]] = {}
+    auto = 1
+    for spec in specs:
+        for part in spec.split(","):
+            if not part:
+                continue
+            mid_s, sep, addr = part.partition("=")
+            if sep:
+                mid = int(mid_s)
+            else:
+                addr = part
+                mid = auto
+            auto = mid + 1
+            host, _, port = addr.rpartition(":")
+            addrs[mid] = (host, int(port))
+    return addrs
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="rebalancerd",
+                                description=__doc__)
+    p.add_argument("--admin", action="append", default=[],
+                   help="member admin endpoint [id=]host:port "
+                        "(repeatable or comma-separated)")
+    p.add_argument("--once", action="store_true",
+                   help="one pass, then exit (0 iff converged)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable pass report")
+    p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--skew-ratio", type=float, default=1.5,
+                   help="trigger/convergence bar: max leaders over "
+                        "fair share")
+    p.add_argument("--cooldown", type=float, default=10.0,
+                   help="per-group re-move quarantine seconds")
+    p.add_argument("--max-moves", type=int, default=64)
+    p.add_argument("--max-retries", type=int, default=3)
+    p.add_argument("--wait", type=float, default=5.0,
+                   help="bounded wait per transfer completion")
+    p.add_argument("--out", default="",
+                   help="also write each report as JSON to this path")
+    args = p.parse_args(argv)
+    addrs = _parse_admins(args.admin)
+    if not addrs:
+        print("need at least one --admin [id=]host:port",
+              file=sys.stderr)
+        return 2
+
+    from etcd_tpu.batched.rebalance import (
+        AdminActuator,
+        RebalanceConfig,
+        Rebalancer,
+    )
+
+    act = AdminActuator(addrs)
+    reb = Rebalancer(act, RebalanceConfig(
+        skew_ratio=args.skew_ratio, cooldown_s=args.cooldown,
+        max_moves_per_pass=args.max_moves,
+        max_retries=args.max_retries, transfer_wait_s=args.wait))
+
+    def emit(rep: Dict) -> None:
+        if args.json:
+            print(json.dumps(rep), flush=True)
+        else:
+            print(f"[{time.strftime('%H:%M:%S')}] "
+                  f"ratio {rep['ratio_before']} -> "
+                  f"{rep['ratio_after']}  moved {rep['moved']} "
+                  f"(failed {rep['failed']}, cooldown "
+                  f"{rep['cooldown_vetoed']})  "
+                  f"balance {rep['balance_after']}", flush=True)
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(rep, fh, indent=1)
+                fh.write("\n")
+
+    try:
+        if args.once:
+            rep = reb.run_once()
+            emit(rep)
+            return 0 if rep["converged"] else 1
+        while True:
+            emit(reb.run_once())
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        act.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
