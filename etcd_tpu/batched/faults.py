@@ -371,7 +371,9 @@ class ChaosHarness:
                  tick_interval: float = 0.02,
                  pipeline: bool = True,
                  fence: bool = True,
-                 trace: bool = False) -> None:
+                 trace: bool = False,
+                 wal_pipeline: bool = False,
+                 wal_group_max_delay: Optional[float] = None) -> None:
         assert transport in ("inproc", "tcp"), transport
         self.data_dir = data_dir
         self.seed = seed
@@ -409,6 +411,13 @@ class ChaosHarness:
         self.transport = transport
         self.tick_interval = tick_interval
         self.pipeline = pipeline
+        # wal_pipeline=True flies the episode with the async
+        # group-commit WAL pipeline (ISSUE 13) on every member: the
+        # fsync runs decoupled from the round cadence and acks release
+        # only at fsync completion — every chaos cell must close at the
+        # same strict bar, or a pipeline reordering leaked.
+        self.wal_pipeline = bool(wal_pipeline)
+        self.wal_group_max_delay = wal_group_max_delay
         self.plan = FaultPlan(seed, spec)
         self.fabric = FaultyFabric(
             self.plan, incarnation_fn=self._member_incarnation,
@@ -454,6 +463,8 @@ class ChaosHarness:
             mid, self.r, self.g, self.data_dir, cfg=self.cfg,
             tick_interval=self.tick_interval, pipeline=self.pipeline,
             fence=self.fence, trace=self.trace or None,
+            wal_pipeline=self.wal_pipeline or None,
+            wal_group_max_delay=self.wal_group_max_delay,
         )
         if self.inproc is not None:
             self.inproc.attach(m)
@@ -532,11 +543,17 @@ class ChaosHarness:
         """Arm a storage failpoint to crash `mid` at its next
         persistence pass (site: 'before_save' = the Ready batch is
         lost; 'after_save' = persisted but never applied before the
-        crash — _replay must re-apply it) and wait for the member to
-        die."""
+        crash — _replay must re-apply it; 'before_fsync_release' = the
+        async WAL pipeline's window: records written to the fd, fsync
+        not yet run, NOTHING released — the batch's acks/sends must
+        never have escaped, and a tear of the written-unsynced suffix
+        must cost only unacked bytes) and wait for the member to die."""
         m = self.members[mid]
-        name = (m._fp_before_save if site == "before_save"
-                else m._fp_after_save)
+        name = {
+            "before_save": m._fp_before_save,
+            "after_save": m._fp_after_save,
+            "before_fsync_release": m._fp_before_release,
+        }[site]
 
         def act(m=m, name=name):
             m.crash()
@@ -567,6 +584,7 @@ class ChaosHarness:
         # NEW member would crash at its first persistence pass too.
         failpoint.disable(old._fp_before_save)
         failpoint.disable(old._fp_after_save)
+        failpoint.disable(old._fp_before_release)
         m = self._boot(mid)
         m.start()
         return m

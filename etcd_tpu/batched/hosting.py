@@ -23,7 +23,19 @@ groups per process", ref: raft/tracker/inflights.go:71-73): a
   tails beyond raft's durability model) and boot the damaged groups
   **fenced** — out of elections until the probe/snapshot catch-up
   restores the durable log ("Protocol-Aware Recovery for
-  Consensus-Based Storage", FAST'18).
+  Consensus-Based Storage", FAST'18),
+* an optional **async group-commit WAL pipeline** (``wal_pipeline``,
+  ISSUE 13): persistence runs on a dedicated WAL-commit worker instead
+  of inline in the Ready drain. Producers append pre-serialized record
+  batches to an open double buffer and continue into the next device
+  round immediately; the worker swaps the buffer, writes it, runs ONE
+  fsync covering every batch queued since the last one (bounded by a
+  max-delay / max-bytes accumulation window), and only then releases
+  the covered batches' acks, sends and applies — persist-before-
+  ack/send preserved by the ordered release barrier, never by timing
+  (the decoupling the reference's asynchronous-storage-writes design
+  permits: raft only requires persist before ack/send, not before the
+  next round).
 
 Members exchange per-round message batches. ``InProcRouter`` wires
 members in one process (tests, single-host demos); the TCP fabric for
@@ -41,7 +53,7 @@ import struct
 import threading
 import time
 from collections import defaultdict
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -210,6 +222,57 @@ def _pack_snap(group: int, index: int, term: int, data: bytes) -> bytes:
 _unpack_snap = _unpack_entry
 
 
+def _env_wal_pipeline() -> bool:
+    """ETCD_TPU_WAL_PIPELINE: default for members constructed with
+    wal_pipeline=None (the hosted_bench / hosting_proc env knob)."""
+    from ..pkg import env_flag
+
+    return env_flag("ETCD_TPU_WAL_PIPELINE")
+
+
+# Group-commit accumulation defaults (overridable per member and via
+# env): after the first pending batch the WAL-commit worker waits up to
+# max_delay for more rounds' batches to queue (one fsync then covers
+# them all), cutting the wait short once max_bytes are pending. 0 delay
+# means fsync as soon as the worker gets the buffer — batching then
+# comes only from rounds that queue WHILE an fsync is in flight, which
+# on a real disk (fsync >> round) is already most of the win.
+# KNOB HAZARD: every outbound message — vote responses included —
+# rides the release barrier (raft requires the vote/hardstate durable
+# before the grant leaves), so a max_delay rivaling the election
+# timeout (election_timeout ticks x tick_interval) delays vote acks
+# past it and starves elections. Keep max_delay well under a quarter
+# of the timeout.
+WAL_GROUP_MAX_DELAY_S = 0.0
+WAL_GROUP_MAX_BYTES = 4 << 20
+
+
+class _PersistGroup:
+    """One submitted persistence batch riding the WAL pipeline: the
+    pre-serialized records (built under _lock, so record order ==
+    submission order == lock order), the Readys whose acks/sends/apply
+    it gates, the per-row durable-watermark deltas to fold into the
+    mirrors once the covering fsync lands, and the snapshot-install
+    generations captured at submit (a MsgSnap restore racing ahead of
+    this batch's fsync supersedes its mirror deltas — see
+    _apply_wm_locked)."""
+
+    __slots__ = ("records", "readys", "wm", "gens", "must_sync",
+                 "nbytes", "t_submit", "on_synced", "traced")
+
+    def __init__(self, records, readys, wm, gens, must_sync,
+                 on_synced=None, traced=()):
+        self.records = records
+        self.readys = readys
+        self.wm = wm
+        self.gens = gens
+        self.must_sync = must_sync
+        self.nbytes = sum(len(d) for _rt, d in records)
+        self.t_submit = time.monotonic()
+        self.on_synced = on_synced
+        self.traced = traced
+
+
 def _pack_wm(group: int, last: int, last_term: int, commit: int) -> bytes:
     return struct.pack("<IQQQ", group, last, last_term, commit)
 
@@ -270,6 +333,9 @@ class MultiRaftMember:
         mesh_devices: int = 0,
         fence: bool = True,
         trace: Optional[bool] = None,
+        wal_pipeline: Optional[bool] = None,
+        wal_group_max_delay: Optional[float] = None,
+        wal_group_max_bytes: Optional[int] = None,
     ) -> None:
         self.id = member_id
         self.slot = member_id - 1
@@ -309,6 +375,13 @@ class MultiRaftMember:
         # harnesses enable them per-member by these names.
         self._fp_before_save = f"hosting.m{member_id}.raftBeforeSave"
         self._fp_after_save = f"hosting.m{member_id}.raftAfterSave"
+        # Pipeline-aware kill point (ISSUE 13): fires on the WAL-commit
+        # worker AFTER the wave's records are written to the fd but
+        # BEFORE the covering fsync/release — a crash here leaves a
+        # written-but-unfsynced tail whose batches were never acked,
+        # exactly the window the async pipeline introduces.
+        self._fp_before_release = (
+            f"hosting.m{member_id}.raftBeforeFsyncRelease")
         # Wall-seconds per phase of the member pipeline (ETCD_TPU_PROF
         # companion at the hosting layer; read via the admin 'prof' op).
         self.stats = {"rounds": 0, "round_s": 0.0, "wal_s": 0.0,
@@ -460,12 +533,65 @@ class MultiRaftMember:
             threading.Thread(target=self._drain_loop, daemon=True)
             if pipeline else None
         )
+        # Async group-commit WAL pipeline (ISSUE 13). Knobs are member
+        # args (NOT BatchedConfig fields: the jitted round program is
+        # cached per config VALUE, and a host-only knob must never fork
+        # a compile); wal_pipeline=None defers to ETCD_TPU_WAL_PIPELINE.
+        # Lock hierarchy (the lock-order sentinel polices it):
+        # _lock -> {_wal_io, _wal_cv}; the worker takes them one at a
+        # time and never holds _wal_io or _wal_cv while acquiring _lock.
+        # _wal_io serializes every native-handle touch against
+        # crash()/stop() closing it mid-fsync; _wal_cv guards the open
+        # double buffer (_wal_pending — producers append, the worker
+        # swaps the whole list out).
+        if wal_pipeline is None:
+            wal_pipeline = _env_wal_pipeline()
+        self._wal_max_delay = (
+            WAL_GROUP_MAX_DELAY_S if wal_group_max_delay is None
+            else float(wal_group_max_delay))
+        self._wal_max_bytes = (
+            WAL_GROUP_MAX_BYTES if wal_group_max_bytes is None
+            else int(wal_group_max_bytes))
+        self._wal_cv = threading.Condition()
+        self._wal_pending: List[_PersistGroup] = []
+        self._wal_stop = False
+        self._wal_io = threading.Lock()
+        self._wal_closed = False
+        # Snapshot-install generation per group: deliver()'s MsgSnap
+        # restore bumps it at submit, and a pipeline batch whose
+        # records were built under an older generation skips its mirror
+        # delta for that row at fsync completion (the snapshot's state
+        # supersedes it; see _apply_wm_locked).
+        self._snap_gen = np.zeros(num_groups, np.int64)
+        self._wal_worker: Optional[threading.Thread] = (
+            threading.Thread(target=self._wal_commit_loop, daemon=True)
+            if wal_pipeline else None
+        )
+        self._m_wal_depth = self._m_wal_batches = None
+        self._m_wal_bytes = self._m_wal_release = None
+        if wal_pipeline:
+            from .telemetry import (
+                wal_pipeline_batches_histogram,
+                wal_pipeline_bytes_histogram,
+                wal_pipeline_depth_gauge,
+                wal_pipeline_release_histogram,
+            )
+
+            mid = str(member_id)
+            self._m_wal_depth = wal_pipeline_depth_gauge().labels(mid)
+            self._m_wal_batches = (
+                wal_pipeline_batches_histogram().labels(mid))
+            self._m_wal_bytes = wal_pipeline_bytes_histogram().labels(mid)
+            self._m_wal_release = (
+                wal_pipeline_release_histogram().labels(mid))
 
     def start(self) -> None:
         self._ticker.start()
         self._runner.start()
         if self._drainer is not None:
             self._drainer.start()
+        if self._wal_worker is not None:
+            self._wal_worker.start()
 
     # -- boot ------------------------------------------------------------------
 
@@ -730,113 +856,190 @@ class MultiRaftMember:
             self._process_readys([rd])
         return rd
 
-    def _process_readys(self, batch: List[BatchedReady]) -> None:
-        """Persist (one fsync for the whole batch) → apply → send, in
-        round order. Watermark records go FIRST: a tail cut destroying
-        this batch's fsync'd entry records then still leaves the record
+    def _build_persist_records(
+            self, batch: List[BatchedReady],
+    ) -> Tuple[bool, Dict[int, List[int]], List[Tuple[int, bytes]]]:
+        """Serialize one Ready batch's persistence work (caller holds
+        _lock): (must_sync, per-row durable deltas, WAL records in
+        write order). Watermark records go FIRST: a tail cut destroying
+        the batch's fsync'd entry records then still leaves the record
         that demanded them, so _replay detects the loss and fences."""
+        must_sync = False
+        records: List[Tuple[int, bytes]] = []
+        # Per-group durable deltas across the whole batch:
+        # row -> [last, last_term, commit, has_entries]. Entries
+        # replay in order, so the final entry processed IS the new
+        # last (truncate-and-append semantics included).
+        wm: Dict[int, List[int]] = {}
+
+        def _wm_row(row: int) -> List[int]:
+            ent = wm.get(row)
+            if ent is None:
+                ent = wm[row] = [
+                    int(self._dur_last[row]), int(self._dur_term[row]),
+                    int(self._dur_commit[row]), 0,
+                ]
+            return ent
+
+        for rd in batch:
+            for row, _term, _vote, commit in rd.hardstates:
+                ent = _wm_row(row)
+                if commit > ent[2]:
+                    ent[2] = commit
+            eb = rd.entries
+            if len(eb):
+                # Last entry per row IS the row's new durable
+                # (last, last_term): entries are row-ascending with
+                # ascending indexes, so segment boundaries give the
+                # per-row finals without a per-entry pass.
+                rows_a = eb.rows
+                ends = np.nonzero(np.diff(rows_a))[0]
+                lasts = np.append(ends, len(rows_a) - 1)
+                for j in lasts.tolist():
+                    ent = _wm_row(int(rows_a[j]))
+                    ent[0] = int(eb.idx[j])
+                    ent[1] = int(eb.term[j])
+                    ent[3] = 1
+            must_sync |= rd.must_sync
+        if self.fence_enabled:
+            wm_rows: List[Tuple[int, int, int, int]] = []
+            for row in sorted(wm):
+                last, lterm, commit, has_ents = wm[row]
+                if not has_ents:
+                    continue  # commit-only: no durability promise moves
+                if self._fenced[row] and last < self._wm_last[row]:
+                    # Never lower the demand mid-heal: a crash
+                    # during catch-up must re-fence at the original
+                    # pre-loss watermark, not the partial one.
+                    last = int(self._wm_last[row])
+                    lterm = int(self._wm_term[row])
+                if self._fenced[row]:
+                    commit = max(commit, int(self._wm_commit[row]))
+                wm_rows.append((row, last, lterm, commit))
+            if wm_rows:
+                wma = np.array(wm_rows, np.int64)
+                records.append((RT_WM_BATCH, _pack_rows(
+                    WAL_WM_DTYPE,
+                    {"group": wma[:, 0], "last": wma[:, 1],
+                     "last_term": wma[:, 2], "commit": wma[:, 3]})))
+        for rd in batch:
+            if rd.hardstates:
+                # jitlint: waive(sync-in-loop) -- rd.hardstates is a host list (no device buffer); one pack per Ready of the drain batch, bounded by batch depth
+                hsa = np.array(rd.hardstates, np.int64)
+                records.append((RT_HS_BATCH, _pack_rows(
+                    WAL_HS_DTYPE,
+                    {"group": hsa[:, 0], "term": hsa[:, 1],
+                     "vote": hsa[:, 2], "commit": hsa[:, 3]})))
+            if len(rd.entries):
+                records.append(
+                    (RT_ENTRY_BATCH, _pack_entry_batch(rd.entries)))
+        return must_sync, wm, records
+
+    def _apply_wm_locked(self, wm: Dict[int, List[int]], synced: bool,
+                         gens: Optional[Dict[int, int]] = None) -> None:
+        """Fold one batch's durable deltas into the mirrors (caller
+        holds _lock). Durable mirrors move only once the records are
+        fsync'd (entries always set must_sync); the commit mirror rides
+        along unsynced — it gates nothing in the fence protocol.
+        ``gens``: snapshot-install generations captured at submit (WAL
+        pipeline) — a row whose generation moved had a MsgSnap restore
+        land AFTER this batch's records were built, and the snapshot's
+        (already-applied, strictly-newer) mirrors must not be clobbered
+        with this batch's stale delta. Skipping is safe-conservative:
+        mirrors only ever claim LESS durable than reality that way, and
+        the next entry-carrying batch re-converges them."""
+        for row, (last, lterm, commit, has_ents) in wm.items():
+            stale = (gens is not None
+                     and gens.get(row, 0) != self._snap_gen[row])
+            if has_ents and synced and not stale:
+                self._dur_last[row] = last
+                self._dur_term[row] = lterm
+                if not self._fenced[row]:
+                    # Track the recorded watermark for healthy rows
+                    # (fenced rows keep demanding the boot-time
+                    # watermark until the lift below).
+                    self._wm_last[row] = last
+                    self._wm_term[row] = lterm
+                    self._wm_commit[row] = max(
+                        self._wm_commit[row], commit)
+            self._dur_commit[row] = max(self._dur_commit[row], commit)
+
+    def _wal_submit_locked(self, records: List[Tuple[int, bytes]],
+                           must_sync: bool,
+                           batch: Sequence[BatchedReady] = (),
+                           wm: Optional[Dict[int, List[int]]] = None,
+                           on_synced: Optional[Callable[[], None]] = None,
+                           ) -> None:
+        """Queue one persistence batch on the WAL pipeline (caller
+        holds _lock, which makes submission order == record-build
+        order across the drain, conf-apply and snapshot-restore
+        producers). The worker owns the native handle exclusively from
+        here on."""
+        gens = {row: int(self._snap_gen[row]) for row in wm} \
+            if wm is not None else None
+        traced = ()
+        if self.tracer is not None:
+            traced = [rd.traced_entries for rd in batch
+                      if rd.traced_entries]
+        g = _PersistGroup(records, list(batch), wm, gens, must_sync,
+                          on_synced=on_synced, traced=traced)
+        with self._wal_cv:
+            self._wal_pending.append(g)
+            depth = len(self._wal_pending)
+            self._wal_cv.notify()
+        if self._m_wal_depth is not None:
+            self._m_wal_depth.set(depth)
+
+    def _process_readys(self, batch: List[BatchedReady]) -> None:
+        """Persist → apply → send, in round order. With the WAL
+        pipeline off: one inline fsync for the whole batch before any
+        of its acks/sends/applies (the pre-ISSUE-13 behavior). With it
+        on: serialize the records, queue them on the WAL-commit worker
+        and return — the worker's ordered release barrier runs the
+        apply/send half only after the covering group-commit fsync."""
         fp(self._fp_before_save)  # crash-before-WAL-save injection site
         t0 = time.perf_counter()
         lifts: List[int] = []
         with self._lock:
             if self._crashed:
                 return  # simulated kill: queued Readys are torn away
-            must_sync = False
-            # Per-group durable deltas across the whole batch:
-            # row -> [last, last_term, commit, has_entries]. Entries
-            # replay in order, so the final entry processed IS the new
-            # last (truncate-and-append semantics included).
-            wm: Dict[int, List[int]] = {}
-
-            def _wm_row(row: int) -> List[int]:
-                ent = wm.get(row)
-                if ent is None:
-                    ent = wm[row] = [
-                        int(self._dur_last[row]), int(self._dur_term[row]),
-                        int(self._dur_commit[row]), 0,
-                    ]
-                return ent
-
-            for rd in batch:
-                for row, _term, _vote, commit in rd.hardstates:
-                    ent = _wm_row(row)
-                    if commit > ent[2]:
-                        ent[2] = commit
-                eb = rd.entries
-                if len(eb):
-                    # Last entry per row IS the row's new durable
-                    # (last, last_term): entries are row-ascending with
-                    # ascending indexes, so segment boundaries give the
-                    # per-row finals without a per-entry pass.
-                    rows_a = eb.rows
-                    ends = np.nonzero(np.diff(rows_a))[0]
-                    lasts = np.append(ends, len(rows_a) - 1)
-                    for j in lasts.tolist():
-                        ent = _wm_row(int(rows_a[j]))
-                        ent[0] = int(eb.idx[j])
-                        ent[1] = int(eb.term[j])
-                        ent[3] = 1
-                must_sync |= rd.must_sync
-            if self.fence_enabled:
-                wm_rows: List[Tuple[int, int, int, int]] = []
-                for row in sorted(wm):
-                    last, lterm, commit, has_ents = wm[row]
-                    if not has_ents:
-                        continue  # commit-only: no durability promise moves
-                    if self._fenced[row] and last < self._wm_last[row]:
-                        # Never lower the demand mid-heal: a crash
-                        # during catch-up must re-fence at the original
-                        # pre-loss watermark, not the partial one.
-                        last = int(self._wm_last[row])
-                        lterm = int(self._wm_term[row])
-                    if self._fenced[row]:
-                        commit = max(commit, int(self._wm_commit[row]))
-                    wm_rows.append((row, last, lterm, commit))
-                if wm_rows:
-                    wma = np.array(wm_rows, np.int64)
-                    self.wal.append(RT_WM_BATCH, _pack_rows(
-                        WAL_WM_DTYPE,
-                        {"group": wma[:, 0], "last": wma[:, 1],
-                         "last_term": wma[:, 2], "commit": wma[:, 3]}))
-            for rd in batch:
-                if rd.hardstates:
-                    # jitlint: waive(sync-in-loop) -- rd.hardstates is a host list (no device buffer); one pack per Ready of the drain batch, bounded by batch depth
-                    hsa = np.array(rd.hardstates, np.int64)
-                    self.wal.append(RT_HS_BATCH, _pack_rows(
-                        WAL_HS_DTYPE,
-                        {"group": hsa[:, 0], "term": hsa[:, 1],
-                         "vote": hsa[:, 2], "commit": hsa[:, 3]}))
-                if len(rd.entries):
-                    self.wal.append(
-                        RT_ENTRY_BATCH, _pack_entry_batch(rd.entries))
+            must_sync, wm, records = self._build_persist_records(batch)
+            if self._wal_worker is not None:
+                self._wal_submit_locked(records, must_sync,
+                                        batch=batch, wm=wm)
+                self.stats["batched"] += len(batch)
+                dt = time.perf_counter() - t0
+                self.stats["wal_s"] += dt
+                if self._h_phase is not None:
+                    self._h_phase["wal"].observe(dt)
+                return
+            for rt, data in records:
+                self.wal.append(rt, data)
             if must_sync:
                 tf = time.perf_counter()
+                if self.tracer is not None:
+                    # fsync_wait is stamped at fsync START (the queue/
+                    # build half of the old fsync hop), fsync at
+                    # COMPLETION — one instant pair covers every traced
+                    # key the batch fsync covers.
+                    tw = time.monotonic_ns()
+                    for rd in batch:
+                        self.tracer.stamp_many(
+                            rd.traced_entries, "fsync_wait", tw)
                 self.wal.flush(sync=True)
+                self.stats["wal_fsyncs"] = (
+                    self.stats.get("wal_fsyncs", 0) + 1)
+                self.stats["fsync_s"] = (
+                    self.stats.get("fsync_s", 0.0)
+                    + time.perf_counter() - tf)
                 if self._h_fsync is not None:
                     self._h_fsync.observe(time.perf_counter() - tf)
                 if self.tracer is not None:
-                    # One batch fsync covers every appended record, so
-                    # one stamp instant covers every traced key.
                     tns = time.monotonic_ns()
                     for rd in batch:
                         self.tracer.stamp_many(
                             rd.traced_entries, "fsync", tns)
-            # Durable mirrors move only once the records are fsync'd
-            # (entries always set must_sync); the commit mirror rides
-            # along unsynced — it gates nothing in the fence protocol.
-            for row, (last, lterm, commit, has_ents) in wm.items():
-                if has_ents and must_sync:
-                    self._dur_last[row] = last
-                    self._dur_term[row] = lterm
-                    if not self._fenced[row]:
-                        # Track the recorded watermark for healthy rows
-                        # (fenced rows keep demanding the boot-time
-                        # watermark until the lift below).
-                        self._wm_last[row] = last
-                        self._wm_term[row] = lterm
-                        self._wm_commit[row] = max(
-                            self._wm_commit[row], commit)
-                self._dur_commit[row] = max(self._dur_commit[row], commit)
+            self._apply_wm_locked(wm, must_sync)
             lifts = self._fence_lift_locked()
         dt = time.perf_counter() - t0
         self.stats["wal_s"] += dt
@@ -847,6 +1050,147 @@ class MultiRaftMember:
         fp(self._fp_after_save)  # crash-after-save-before-apply site
         for rd in batch:
             self._apply_and_send(rd)
+
+    # -- WAL-commit worker (async group-commit pipeline, ISSUE 13) -------------
+
+    def _wal_commit_loop(self) -> None:
+        """Dedicated persistence stage: swap the open double buffer,
+        optionally dwell (max-delay/max-bytes group-commit window) so
+        more rounds' batches coalesce, write + fsync ONCE for the whole
+        wave, fold the durable mirrors, then release every covered
+        batch's apply/send in submission order. Guarded like the drain
+        worker: an escaping storage/transport fault is fatal to the
+        member, never swallowed."""
+        try:
+            while True:
+                with self._wal_cv:
+                    while not self._wal_pending and not self._wal_stop:
+                        self._wal_cv.wait()
+                    wave = self._wal_pending
+                    self._wal_pending = []
+                    stopping = self._wal_stop
+                if not wave:
+                    return  # stop() with nothing pending
+                nbytes = sum(g.nbytes for g in wave)
+                if self._wal_max_delay > 0 and not stopping:
+                    deadline = time.monotonic() + self._wal_max_delay
+                    while nbytes < self._wal_max_bytes:
+                        rem = deadline - time.monotonic()
+                        if rem <= 0:
+                            break
+                        with self._wal_cv:
+                            if (not self._wal_pending
+                                    and not self._wal_stop):
+                                self._wal_cv.wait(rem)
+                            more = self._wal_pending
+                            self._wal_pending = []
+                            stopping = self._wal_stop
+                        wave.extend(more)
+                        nbytes += sum(g.nbytes for g in more)
+                        if stopping:
+                            break
+                if self._m_wal_depth is not None:
+                    self._m_wal_depth.set(0)
+                self._commit_wave(wave, nbytes)
+                if stopping:
+                    with self._wal_cv:
+                        if not self._wal_pending:
+                            return
+        except FailpointPanic:
+            # Injected crash (chaos harness) at the pipeline kill
+            # point; finish the kill if the site was armed with the
+            # bare 'panic' action (see _drain_loop).
+            _log.info("member %d: injected crash (WAL-commit worker)",
+                      self.id)
+            if not self._crashed:
+                self.crash()
+        except Exception:  # noqa: BLE001 — fatal: log + stop the member
+            _log.exception(
+                "member %d: WAL-commit worker died; stopping member",
+                self.id)
+            self.stats["walpipe_dead"] = (
+                self.stats.get("walpipe_dead", 0) + 1)
+            self.stop()
+
+    def _commit_wave(self, wave: List[_PersistGroup],
+                     nbytes: int) -> None:
+        """Write + group-commit one wave, then run the ordered release
+        barrier. Never called with member locks held; takes _wal_io
+        around every handle touch (crash()/stop() close under it) and
+        _lock only for the mirror fold."""
+        must_sync = any(g.must_sync for g in wave)
+        with self._wal_io:
+            if self._wal_closed:
+                return  # crashed: the wave is torn away like a real kill
+            for g in wave:
+                for rt, data in g.records:
+                    self.wal.append(rt, data)
+            self.wal.flush(sync=False)  # bytes to the fd; NOT yet durable
+        # The pipeline's chaos window: records written, fsync pending,
+        # nothing released/acked. Outside _wal_io so a crash() action
+        # at the site can take _lock -> _wal_io itself.
+        fp(self._fp_before_release)
+        tw_ns = time.monotonic_ns()  # fsync start (fsync_wait stamp)
+        tf = time.perf_counter()
+        if must_sync:
+            with self._wal_io:
+                if self._wal_closed:
+                    return
+                self.wal.flush(sync=True)
+        dt_sync = time.perf_counter() - tf
+        td_ns = time.monotonic_ns()  # fsync completion (fsync stamp)
+        lifts: List[int] = []
+        with self._lock:
+            if self._crashed:
+                return
+            for g in wave:
+                if g.wm is not None:
+                    self._apply_wm_locked(g.wm, must_sync, g.gens)
+                if g.on_synced is not None:
+                    g.on_synced()
+            lifts = self._fence_lift_locked()
+        self._fence_lift_apply(lifts)
+        if must_sync:
+            self.stats["wal_fsyncs"] = self.stats.get("wal_fsyncs", 0) + 1
+            self.stats["fsync_s"] = (
+                self.stats.get("fsync_s", 0.0) + dt_sync)
+            if self._h_fsync is not None:
+                self._h_fsync.observe(dt_sync)
+            # Amortization accounting rides the fsyncs only: an idle
+            # no-sync wave covering empty rounds must not inflate the
+            # rounds-per-fsync ratio the pipeline is judged by.
+            rounds = sum(len(g.readys) for g in wave)
+            self.stats["wal_fsync_rounds"] = (
+                self.stats.get("wal_fsync_rounds", 0) + rounds)
+            self.stats["wal_fsync_bytes"] = (
+                self.stats.get("wal_fsync_bytes", 0) + nbytes)
+            if self._m_wal_batches is not None:
+                # Round-Ready batches only: readys-less submissions
+                # (conf records, snapshot installs) must not inflate
+                # the coverage metric, and the histogram must agree
+                # with the health op's rounds_per_fsync ratio.
+                if rounds:
+                    self._m_wal_batches.observe(rounds)
+                self._m_wal_bytes.observe(nbytes)
+        if self.tracer is not None:
+            # The covering group-commit's instants, for every traced
+            # key in the wave: fsync_wait at fsync start (queue half),
+            # fsync at completion — the satellite contract that keeps
+            # the SLO hop table telescoping with the pipeline on.
+            for g in wave:
+                for keys in g.traced:
+                    self.tracer.stamp_many(keys, "fsync_wait", tw_ns)
+                    self.tracer.stamp_many(keys, "fsync", td_ns)
+        fp(self._fp_after_save)  # fsync'd-but-unreleased kill window
+        # Ordered release barrier: acks, sends and applies of a batch
+        # leave ONLY here, after its covering fsync — persist-before-
+        # send/ack by construction, not by timing.
+        now = time.monotonic()
+        for g in wave:
+            if self._m_wal_release is not None and g.readys:
+                self._m_wal_release.observe(now - g.t_submit)
+            for rd in g.readys:
+                self._apply_and_send(rd)
 
     def _apply_and_send(self, rd: BatchedReady) -> None:
         if self._crashed:
@@ -882,8 +1226,17 @@ class MultiRaftMember:
                 # _replay.
                 conf_changed = sorted(set(conf_changed))
                 rows = np.asarray(conf_changed)
-                self.wal.append(RT_CONF_BATCH,
-                                self.conf.pack_groups(rows))
+                packed = self.conf.pack_groups(rows)
+                if self._wal_worker is not None:
+                    # Pipeline mode: the worker owns the handle, so the
+                    # record rides the open buffer — same durability
+                    # contract (the next group-commit fsync covers it,
+                    # and a crash before that re-derives the config
+                    # from the already-fsync'd entries at _replay).
+                    self._wal_submit_locked([(RT_CONF_BATCH, packed)],
+                                            must_sync=False)
+                else:
+                    self.wal.append(RT_CONF_BATCH, packed)
                 # Stage the device masks UNDER the same lock as the
                 # conf mutation (member._lock -> rn._lock nesting is
                 # established — install_snapshot_state does the same):
@@ -1266,7 +1619,29 @@ class MultiRaftMember:
             learner_slots = int(self.conf.learner.sum())
             conf_applied = int(self.conf.epoch.sum())
             conf_refused = int(self.conf.refused)
+        with self._wal_cv:
+            wal_depth = len(self._wal_pending)
+        fsyncs = int(self.stats.get("wal_fsyncs", 0))
+        rounds_covered = int(self.stats.get("wal_fsync_rounds", 0))
+        wal_pipe = {
+            # Async group-commit pipeline visibility (ISSUE 13): live
+            # queue depth, fsync count, and the amortization ratio the
+            # pipeline exists for (device rounds whose persistence one
+            # fsync covered) — fleet_console's wal-pipe column reads
+            # this.
+            "enabled": self._wal_worker is not None,
+            "queue_depth": wal_depth,
+            "fsyncs": fsyncs,
+            "rounds_per_fsync": (
+                round(rounds_covered / fsyncs, 2) if fsyncs else 0.0),
+            "bytes_per_fsync": (
+                int(self.stats.get("wal_fsync_bytes", 0) // fsyncs)
+                if fsyncs else 0),
+            "max_delay_s": self._wal_max_delay,
+            "max_bytes": self._wal_max_bytes,
+        }
         return {
+            "wal_pipeline": wal_pipe,
             "fence_enabled": self.fence_enabled,
             "wal_tail": (TAIL_NAMES.get(self._tail_state, "unknown")
                          if self._tail_state is not None else "fresh"),
@@ -1309,11 +1684,11 @@ class MultiRaftMember:
                     self.rn.install_snapshot_state(group, idx)
                     # WAL-record the snapshot before any post-restore
                     # state can be acknowledged.
-                    self.wal.append(
+                    records: List[Tuple[int, bytes]] = [(
                         RT_SNAPSHOT,
                         _pack_snap(group, idx, snap_term,
                                    m.snapshot.data),
-                    )
+                    )]
                     # Membership rides the snapshot metadata: conf
                     # entries in the skipped log never arrive, so the
                     # carried ConfState supersedes whatever this member
@@ -1322,9 +1697,9 @@ class MultiRaftMember:
                     if cs is not None and cs.voters:
                         if self.conf.restore(group, idx, cs):
                             rows = np.asarray([group])
-                            self.wal.append(
+                            records.append((
                                 RT_CONF_BATCH,
-                                self.conf.pack_groups(rows))
+                                self.conf.pack_groups(rows)))
                             # Stage under the SAME lock as the conf
                             # mutation (see the conf-apply path): a
                             # post-release staging can lose the
@@ -1334,32 +1709,58 @@ class MultiRaftMember:
                             self.rn.set_membership_many(
                                 rows, *self.conf.masks(rows))
                             self._update_conf_gauges()
-                    # Snapshot-driven heal: the install makes (idx,
-                    # snap_term) durable and committed, so the durable
-                    # mirrors jump with it and a fence demanding
-                    # anything at-or-below idx lifts right here —
-                    # protocol-aware re-convergence needs no log
-                    # replay when the quorum ships state directly.
-                    if idx > self._dur_last[group]:
-                        self._dur_last[group] = idx
-                        self._dur_term[group] = snap_term
-                    self._dur_commit[group] = max(
-                        self._dur_commit[group], idx)
+                    wl = wt = None
                     if self.fence_enabled:
                         wl = max(idx, int(self._wm_last[group]))
                         wt = (snap_term if wl == idx
                               else int(self._wm_term[group]))
-                        self.wal.append(
+                        records.append((
                             RT_WATERMARK,
                             _pack_wm(group, wl, wt,
-                                     max(idx, int(self._wm_commit[group]))))
-                        if not self._fenced[group]:
+                                     max(idx,
+                                         int(self._wm_commit[group])))))
+
+                    def _snap_mirrors(group=group, idx=idx,
+                                      snap_term=snap_term,
+                                      wl=wl, wt=wt) -> None:
+                        # Snapshot-driven heal: the install makes (idx,
+                        # snap_term) durable and committed, so the
+                        # durable mirrors jump with it and a fence
+                        # demanding anything at-or-below idx lifts —
+                        # protocol-aware re-convergence needs no log
+                        # replay when the quorum ships state directly.
+                        # Runs ONLY once the records above are fsync'd
+                        # (inline below, or the pipeline's on_synced
+                        # callback under _lock).
+                        if idx > self._dur_last[group]:
+                            self._dur_last[group] = idx
+                            self._dur_term[group] = snap_term
+                        self._dur_commit[group] = max(
+                            self._dur_commit[group], idx)
+                        if wl is not None and not self._fenced[group]:
                             self._wm_last[group] = wl
                             self._wm_term[group] = wt
                             self._wm_commit[group] = max(
                                 self._wm_commit[group], idx)
-                    self.wal.flush(sync=True)
-                    lifts = self._fence_lift_locked()
+
+                    if self._wal_worker is not None:
+                        # Pipeline mode: the records ride the open
+                        # buffer IN ORDER with every pending round
+                        # batch; the generation bump makes any
+                        # already-submitted (older) batch skip its
+                        # now-stale mirror delta for this group, and
+                        # the mirror jump itself waits for the covering
+                        # fsync via on_synced.
+                        self._snap_gen[group] += 1
+                        self._wal_submit_locked(
+                            records, must_sync=True,
+                            on_synced=_snap_mirrors)
+                    else:
+                        for rt, d in records:
+                            self.wal.append(rt, d)
+                        self.wal.flush(sync=True)
+                        _snap_mirrors()
+                        lifts = self._fence_lift_locked()
             self._fence_lift_apply(lifts)
         self.rn.step(group, m)
         self._work.set()
@@ -1480,11 +1881,26 @@ class MultiRaftMember:
                 return
             self._crashed = True
             self._stopped.set()
-            try:
-                self._wal_tail_at_crash = self.wal.tail_offset()
-                self.wal.close()
-            except WalogError:
-                pass
+            # _wal_io nested under _lock (the documented order): the
+            # WAL-commit worker holds _wal_io for the duration of any
+            # in-flight write/fsync and NEVER takes _lock while holding
+            # it, so this close waits out at most one fsync and can
+            # never race the native handle (a close under a live
+            # fdatasync is C-level use-after-free).
+            with self._wal_io:
+                self._wal_closed = True
+                try:
+                    self._wal_tail_at_crash = self.wal.tail_offset()
+                    self.wal.close()
+                except WalogError:
+                    pass
+        # Unpark the WAL-commit worker; pending waves are torn away by
+        # its _wal_closed/_crashed gates — exactly the unfsynced,
+        # never-acked suffix a real kill at this point loses.
+        if self._wal_worker is not None:
+            with self._wal_cv:
+                self._wal_stop = True
+                self._wal_cv.notify_all()
         self._work.set()
         with self._read_cv:
             self._read_cv.notify_all()
@@ -1536,15 +1952,37 @@ class MultiRaftMember:
                         continue
                 self._drainer.join(timeout=60)
                 drainer_done = not self._drainer.is_alive()
+        # Drain the WAL pipeline DETERMINISTICALLY: the drainer above
+        # already submitted every queued Ready, so signaling stop and
+        # joining the worker flushes + releases every pending wave —
+        # stop() returns with nothing in flight and nothing lost (the
+        # stop-during-pending-fsync regression). A stop() issued FROM
+        # the worker (its fatal-fault guard) skips the join; the
+        # worker is exiting anyway and the close below stays guarded.
+        walworker_done = True
+        if self._wal_worker is not None and self._wal_worker.is_alive():
+            if self._wal_worker is threading.current_thread():
+                walworker_done = False
+            else:
+                with self._wal_cv:
+                    self._wal_stop = True
+                    self._wal_cv.notify_all()
+                self._wal_worker.join(timeout=60)
+                walworker_done = not self._wal_worker.is_alive()
         with self._lock:
-            self.wal.flush(sync=True)
-            if drainer_done:
-                # Never close the WAL under a live drain worker — its
-                # next append would hit a closed file and silently drop
-                # the queued rounds' persistence. Leaving it open on a
-                # wedged drain is safe: process exit closes the fd and
-                # the CRC chain ends at the last completed record.
-                self.wal.close()
+            with self._wal_io:
+                if self._wal_closed:
+                    return  # crash() already tore the handle down
+                self.wal.flush(sync=True)
+                if drainer_done and walworker_done:
+                    # Never close the WAL under a live drain/WAL-commit
+                    # worker — its next append would hit a closed file
+                    # and silently drop the queued rounds' persistence.
+                    # Leaving it open on a wedged worker is safe:
+                    # process exit closes the fd and the CRC chain ends
+                    # at the last completed record.
+                    self.wal.close()
+                    self._wal_closed = True
 
 
 class InProcRouter:
@@ -2101,14 +2539,19 @@ class MultiRaftCluster:
                  pipeline: bool = True,
                  mesh_devices: int = 0,
                  fence: bool = True,
-                 trace: Optional[bool] = None) -> None:
+                 trace: Optional[bool] = None,
+                 wal_pipeline: Optional[bool] = None,
+                 wal_group_max_delay: Optional[float] = None,
+                 wal_group_max_bytes: Optional[int] = None) -> None:
         self.router = InProcRouter()
         self.members: Dict[int, MultiRaftMember] = {}
         for mid in range(1, num_members + 1):
             m = MultiRaftMember(
                 mid, num_members, num_groups, data_dir, cfg=cfg,
                 pipeline=pipeline, mesh_devices=mesh_devices,
-                fence=fence, trace=trace,
+                fence=fence, trace=trace, wal_pipeline=wal_pipeline,
+                wal_group_max_delay=wal_group_max_delay,
+                wal_group_max_bytes=wal_group_max_bytes,
             )
             self.router.attach(m)
             self.members[mid] = m

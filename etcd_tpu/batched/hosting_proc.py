@@ -403,7 +403,8 @@ def serve(member_id: int, num_members: int, num_groups: int,
           tick_interval: float = 0.1,
           telemetry: bool = False,
           fleet: bool = False,
-          trace: Optional[bool] = None) -> None:
+          trace: Optional[bool] = None,
+          wal_pipeline: Optional[bool] = None) -> None:
     from .hosting import MultiRaftMember
     from .state import BatchedConfig
 
@@ -428,6 +429,10 @@ def serve(member_id: int, num_members: int, num_groups: int,
     member = MultiRaftMember(
         member_id, num_members, num_groups, data_dir, cfg=cfg,
         tick_interval=tick_interval, trace=trace,
+        # --wal-pipeline / ETCD_TPU_WAL_PIPELINE (ISSUE 13): async
+        # group-commit WAL pipeline — persistence decoupled from the
+        # round cadence, acks released on fsync completion.
+        wal_pipeline=wal_pipeline,
     )
     from .hosting import TCPRouter
 
@@ -465,6 +470,14 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="enable proposal-lifecycle tracing (sampled "
                         "span stamps; admin 'trace' op serves the "
                         "ring — see ETCD_TPU_TRACE_SAMPLE/_SEED)")
+    p.add_argument("--wal-pipeline", action="store_true",
+                   help="run persistence as an async group-commit "
+                        "pipeline: WAL append+fsync on a dedicated "
+                        "worker overlapped with device rounds, one "
+                        "fsync covering every round queued since the "
+                        "last, acks released at fsync completion "
+                        "(ETCD_TPU_WAL_PIPELINE=1 is the env form; "
+                        "admin 'health' reports rounds_per_fsync)")
     a = p.parse_args(argv)
 
     def hp(s: str) -> Tuple[str, int]:
@@ -478,7 +491,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     serve(a.id, a.members, a.groups, a.data_dir, hp(a.bind),
           hp(a.admin), peers, window=a.window,
           tick_interval=a.tick_interval, telemetry=a.telemetry,
-          fleet=a.fleet, trace=a.trace or None)
+          fleet=a.fleet, trace=a.trace or None,
+          wal_pipeline=a.wal_pipeline or None)
 
 
 # -- client side ---------------------------------------------------------------

@@ -138,6 +138,61 @@ def wal_fsync_histogram(
     ))
 
 
+def wal_pipeline_depth_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    """Persistence batches sitting in the async WAL pipeline's open
+    buffer (ISSUE 13) — sampled at submit and at every worker swap.
+    A depth pinned high means the disk can't keep up with the round
+    cadence even amortized."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_wal_pipeline_queue_depth",
+        "persistence batches queued on the WAL-commit worker",
+        ("member",),
+    ))
+
+
+def wal_pipeline_batches_histogram(
+        registry: Optional[pmet.Registry] = None) -> pmet.Histogram:
+    """Device rounds whose persistence one group-commit fsync covered —
+    the amortization the pipeline exists for (1 == no better than the
+    inline path)."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Histogram(
+        "etcd_tpu_wal_pipeline_batches_per_fsync",
+        "round persistence batches covered by one group-commit fsync",
+        ("member",),
+        buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64),
+    ))
+
+
+def wal_pipeline_bytes_histogram(
+        registry: Optional[pmet.Registry] = None) -> pmet.Histogram:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Histogram(
+        "etcd_tpu_wal_pipeline_bytes_per_fsync",
+        "WAL bytes covered by one group-commit fsync",
+        ("member",),
+        buckets=(1 << 10, 4 << 10, 16 << 10, 64 << 10, 256 << 10,
+                 1 << 20, 4 << 20, 16 << 20),
+    ))
+
+
+def wal_pipeline_release_histogram(
+        registry: Optional[pmet.Registry] = None) -> pmet.Histogram:
+    """Submit→release latency of a persistence batch on the pipeline:
+    the time its acks/sends/applies waited on the covering group-commit
+    fsync (the ack-release barrier's cost, paid OFF the round thread)."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Histogram(
+        "etcd_tpu_wal_pipeline_ack_release_seconds",
+        "WAL-pipeline batch submit-to-release (ack barrier) latency",
+        ("member",),
+        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                 0.1, 0.25, 0.5, 1.0, 2.5),
+    ))
+
+
 def round_phase_histogram(
         registry: Optional[pmet.Registry] = None) -> pmet.Histogram:
     reg = registry or pmet.DEFAULT

@@ -3,9 +3,21 @@
 from __future__ import annotations
 
 import ctypes
+import os
+import time
 from typing import Callable, List, Optional, Tuple
 
 from . import load
+
+# Slow-disk emulation for benches/tests (the etcd idiom is a gofail
+# sleep on the persistence path — tests/robustness uses it to model
+# cloud/HDD-class disks): ETCD_TPU_FSYNC_DELAY_MS adds a GIL-released
+# sleep to every sync flush, i.e. pure IO WAIT, which is what a real
+# fsync is. Default 0 (off); benches that set it MUST label their
+# artifacts with it. This is how the async WAL pipeline's group-commit
+# win is measurable on boxes whose local disk syncs in microseconds.
+_FSYNC_DELAY_S = float(
+    os.environ.get("ETCD_TPU_FSYNC_DELAY_MS", "0") or 0) / 1e3
 
 _REC_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_int,
@@ -79,6 +91,8 @@ class Walog:
         self._check(self._lib.walog_append(self._h, rtype, data, len(data)))
 
     def flush(self, sync: bool = True) -> int:
+        if sync and _FSYNC_DELAY_S > 0:
+            time.sleep(_FSYNC_DELAY_S)  # slow-disk emulation (see top)
         rc = self._lib.walog_flush(self._h, 1 if sync else 0)
         self._check(rc)
         return rc

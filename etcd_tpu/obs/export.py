@@ -24,6 +24,10 @@ HOP_NAMES: Dict[Tuple[str, str], str] = {
     ("propose", "stage"): "enqueue_wait",
     ("stage", "dispatch"): "stage",
     ("dispatch", "extract"): "step",
+    ("extract", "fsync_wait"): "fsync_wait",
+    ("fsync_wait", "fsync"): "fsync",
+    # Dumps from before the fsync_wait split (ISSUE 13) carry one
+    # combined hop; keep them renderable.
     ("extract", "fsync"): "fsync",
     ("fsync", "send"): "send",
     ("send", "commit"): "quorum_wait",

@@ -17,12 +17,16 @@ a BFS chain through members that are.
 
 The hop table decomposes the commit path into named hops::
 
-    enqueue_wait | stage | step | fsync | send | net_to_peer |
-    peer_fsync | peer_ack | ack_to_commit | apply
+    enqueue_wait | stage | step | fsync_wait | fsync | send |
+    net_to_peer | peer_fsync_wait | peer_fsync | peer_ack |
+    ack_to_commit | apply
 
-The hops telescope: their per-span sum equals the span's propose→apply
-end-to-end exactly, so the table is a complete decomposition of commit
-latency, not a sample of it.
+(``fsync_wait`` is the queue half — record build + time behind earlier
+persistence waves, which the async WAL pipeline makes a real hop — and
+``fsync`` the device half, stamped at the covering group-commit's
+completion.) The hops telescope: their per-span sum equals the span's
+propose→apply end-to-end exactly, so the table is a complete
+decomposition of commit latency, not a sample of it.
 """
 
 from __future__ import annotations
@@ -40,10 +44,12 @@ HOPS = (
     ("enqueue_wait", "propose", "stage"),
     ("stage", "stage", "dispatch"),
     ("step", "dispatch", "extract"),
-    ("fsync", "extract", "fsync"),
+    ("fsync_wait", "extract", "fsync_wait"),
+    ("fsync", "fsync_wait", "fsync"),
     ("send", "fsync", "send"),
     ("net_to_peer", "send", "extract_P"),
-    ("peer_fsync", "extract_P", "fsync_P"),
+    ("peer_fsync_wait", "extract_P", "fsync_wait_P"),
+    ("peer_fsync", "fsync_wait_P", "fsync_P"),
     ("peer_ack", "fsync_P", "send_P"),
     ("ack_to_commit", "send_P", "commit"),
     ("apply", "commit", "apply"),
@@ -145,7 +151,8 @@ def _ack_peer(frags: Dict[str, Dict], origin: str,
     for m, s in frags.items():
         if m == origin:
             continue
-        if not all(k in s for k in ("extract", "fsync", "send")):
+        if not all(k in s
+                   for k in ("extract", "fsync_wait", "fsync", "send")):
             continue
         t = s["send"] + offsets.get(m, 0)
         if best is None or t < best[0]:
@@ -187,7 +194,7 @@ def hop_stats(payloads: List[Dict],
         if peer is not None:
             m, s = peer
             off_p = offsets.get(m, 0)
-            for k in ("extract", "fsync", "send"):
+            for k in ("extract", "fsync_wait", "fsync", "send"):
                 st[k + "_P"] = s[k] + off_p
         full = all(a in st and b in st for _n, a, b in HOPS)
         if full:

@@ -41,7 +41,11 @@ STAGES = (
     "stage",     # round staging began (inbox build; advance_round entry)
     "dispatch",  # device round dispatched (host->device staging done)
     "extract",   # device round done; host extraction began
-    "fsync",     # WAL batch fsync covering this entry completed
+    "fsync_wait",  # covering WAL group-commit fsync STARTED (the
+    # extract->fsync_wait hop is record build + persistence-queue wait
+    # — with the async WAL pipeline on, the time the entry sat in the
+    # open buffer behind earlier waves)
+    "fsync",     # covering WAL group-commit fsync COMPLETED
     "send",      # round's outbound batch handed to the transport
     "commit",    # commit watermark reached the entry (extraction time)
     "apply",     # state machine applied the entry

@@ -7,3 +7,13 @@ apply scheduler, request-id generator, interval tree (auth ranges and
 watcher groups), request tracing, heartbeat-contention detection,
 benchmark statistics, and broadcast notification.
 """
+
+import os as _os
+
+
+def env_flag(name: str) -> bool:
+    """The ONE truthiness parse for boolean env knobs ("", "0" and
+    "false" are off; anything else is on) — ETCD_TPU_WAL_PIPELINE,
+    bench drivers and member processes must agree on it, so it lives
+    here instead of being re-derived per call site."""
+    return _os.environ.get(name, "") not in ("", "0", "false")

@@ -24,6 +24,14 @@ item 4's gRPC SLO story consumes. The merged Perfetto trace lands in
 ``artifacts/hosted_trace.json``. Tracing has measurable sampling cost,
 so ``--trace`` runs are labeled and are NOT the parity baseline.
 
+``--wal-pipeline`` (or ``ETCD_TPU_WAL_PIPELINE=1``) flies the workers
+with the async group-commit WAL pipeline (ISSUE 13); A/B rows against
+the same-day inline baseline land in BENCH_NOTES and the
+``artifacts/hosted_walpipe_*.json`` artifacts. Pair with
+``ETCD_TPU_FSYNC_DELAY_MS`` (walog-level slow-disk emulation) on boxes
+whose local fsync is microsecond-class — the pipeline overlaps IO
+wait, so a free fsync leaves nothing to win.
+
 Run:  python -m etcd_tpu.tools.hosted_bench [--groups 1024] [--n 3000]
 """
 
@@ -53,7 +61,7 @@ def free_ports(n):
 
 
 def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0,
-          trace=0):
+          trace=0, wal_pipeline=False):
     peers = [
         f"--peer={pid}=127.0.0.1:{raft_ports[pid]}"
         for pid in range(1, MEMBERS + 1) if pid != mid
@@ -83,7 +91,8 @@ def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0,
             "--bind", f"127.0.0.1:{raft_ports[mid]}",
             "--admin", f"127.0.0.1:{admin_ports[mid]}",
             "--tick-interval", "0.1",
-        ] + (["--trace"] if trace else []) + peers,
+        ] + (["--trace"] if trace else [])
+        + (["--wal-pipeline"] if wal_pipeline else []) + peers,
         env=env, stdout=log, stderr=subprocess.STDOUT,
     )
 
@@ -104,7 +113,20 @@ def main() -> None:
                     help="run the workers with proposal-lifecycle "
                          "tracing (1-in-SAMPLE, default 8) and record "
                          "the per-hop SLO table into the artifact")
+    from etcd_tpu.pkg import env_flag
+
+    ap.add_argument("--wal-pipeline", action="store_true",
+                    default=env_flag("ETCD_TPU_WAL_PIPELINE"),
+                    help="run the workers with the async group-commit "
+                         "WAL pipeline (ISSUE 13); also honored via "
+                         "ETCD_TPU_WAL_PIPELINE=1 — A/B rows against "
+                         "the inline baseline land in BENCH_NOTES")
     args = ap.parse_args()
+    # Slow-disk emulation label (native/walog.py): a bench flown with
+    # ETCD_TPU_FSYNC_DELAY_MS set must say so in its artifact config.
+    fsync_delay = os.environ.get("ETCD_TPU_FSYNC_DELAY_MS", "")
+    delay_tag = (f" fsync_delay={fsync_delay}ms"
+                 if fsync_delay not in ("", "0") else "")
     import tempfile
 
     data_dir = args.data_dir or tempfile.mkdtemp(prefix="hosted-bench-")
@@ -118,7 +140,8 @@ def main() -> None:
     try:
         for mid in range(1, MEMBERS + 1):
             procs[mid] = spawn(mid, raft_p, admin_p, data_dir,
-                               args.groups, trace=args.trace)
+                               args.groups, trace=args.trace,
+                               wal_pipeline=args.wal_pipeline)
         for mid in range(1, MEMBERS + 1):
             clients[mid] = wait_admin(("127.0.0.1", admin_p[mid]),
                                       timeout=300.0)
@@ -206,7 +229,10 @@ def main() -> None:
                 v = st.get(f"rn_{p}")
                 if v is not None:
                     phase_ms.setdefault(p, []).append(v / rounds * 1e3)
-            for p in ("wal", "apply", "send"):
+            # "fsync" (stats fsync_s) is the device half alone; with
+            # the pipeline on it runs OFF the round thread, so the
+            # amortized ms/round here shrinking is the headline.
+            for p in ("wal", "apply", "send", "fsync"):
                 v = st.get(f"{p}_s")
                 if v is not None:
                     phase_ms.setdefault(p, []).append(v / m_rounds * 1e3)
@@ -267,7 +293,9 @@ def main() -> None:
                 slo["config"] = (f"G={args.groups} R={MEMBERS} "
                                  f"value={args.value_size}B "
                                  f"inflight={args.inflight}/group CPU "
-                                 f"trace=1/{args.trace}")
+                                 f"trace=1/{args.trace}"
+                                 + (" walpipe=on" if args.wal_pipeline
+                                    else "") + delay_tag)
                 slo["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
                 print(f"slo: {json.dumps(slo['hops'])}",
                       file=sys.stderr)
@@ -282,7 +310,8 @@ def main() -> None:
                         v="MQ==")
         t0 = time.monotonic()
         procs[3] = spawn(3, raft_p, admin_p, data_dir, args.groups,
-                         gen=1, trace=args.trace)
+                         gen=1, trace=args.trace,
+                         wal_pipeline=args.wal_pipeline)
         clients[3] = wait_admin(("127.0.0.1", admin_p[3]), timeout=300.0)
         while time.monotonic() - t0 < 180.0:
             if clients[3].get(g, b"catchup") == b"1":
@@ -306,7 +335,9 @@ def main() -> None:
                        f"value={args.value_size}B "
                        f"inflight={args.inflight}/group CPU"
                        + (f" trace=1/{args.trace}" if args.trace
-                          else "")),
+                          else "")
+                       + (" walpipe=on" if args.wal_pipeline else "")
+                       + delay_tag),
             "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }
         if slo is not None:

@@ -88,6 +88,7 @@ def pump(rns, rounds):
                 rns[m.to].step(row, m)
             tr = rn.tracer
             if tr is not None:
+                tr.stamp_many(rd.traced_entries, "fsync_wait")
                 tr.stamp_many(rd.traced_entries, "fsync")
                 tr.stamp_many(rd.traced_entries, "send")
                 tr.stamp_many(rd.traced_commit, "apply")

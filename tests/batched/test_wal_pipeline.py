@@ -1,0 +1,211 @@
+"""Async group-commit WAL pipeline (ISSUE 13): persistence decoupled
+from the round cadence must change NOTHING observable but latency.
+
+The pipeline introduces exactly one new crash window — records written
+to the fd, covering fsync not yet complete, nothing released — policed
+here with the pipeline-aware failpoint
+(``hosting.m<id>.raftBeforeFsyncRelease``), torn-tail cuts of the
+written-unsynced suffix, a stop()-during-pending-fsync regression
+(satellite: the pre-pipeline stop path assumed persistence was
+synchronous), a lock-order pass over the WAL-commit worker against the
+member/drain/pump/TCP-sender thread soup, and strict 3-checker closes
+for the pipeline-on chaos cells.
+
+Config is value-identical to tests/batched/test_chaos.py's CFG, so the
+whole module reuses the chaos subset's compiled round program — no
+tier-1 compile budget spent (the WAL pipeline is host-only and never
+forks a device program by construction).
+"""
+
+import time
+
+import pytest
+
+from etcd_tpu.analysis.lockorder import LockOrderRecorder
+from etcd_tpu.batched.faults import (
+    ChaosHarness,
+    FaultSpec,
+    LeaderObserver,
+    run_invariant_checks,
+)
+from etcd_tpu.batched.hosting import MultiRaftCluster
+from etcd_tpu.pkg import failpoint
+from etcd_tpu.pkg import metrics as pmet
+
+from .test_chaos import CFG, G, MSG_FAULTS, R, SEEDS
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+class TestCrashWindows:
+    """A crash between WAL append and fsync completion must never have
+    acked/sent anything from the unfsynced suffix — both orders of the
+    new window (nothing written yet vs written-but-unfsynced)."""
+
+    @pytest.mark.parametrize("site", ["before_save",
+                                      "before_fsync_release"])
+    def test_crash_window_never_loses_acked_writes(self, tmp_path, site):
+        h = ChaosHarness(str(tmp_path), seed=1313, spec=FaultSpec(),
+                         num_members=R, num_groups=G, cfg=CFG,
+                         wal_pipeline=True)
+        obs = LeaderObserver(h.alive)
+        try:
+            h.wait_leaders()
+            obs.start()
+            acked = h.run_workload(6, prefix=b"pre")
+            assert acked >= 3
+            h.crash_on_failpoint(2, site)
+            assert h.members[2]._crashed
+            assert failpoint.hits(getattr(
+                h.members[2], "_fp_" + {
+                    "before_save": "before_save",
+                    "before_fsync_release": "before_release",
+                }[site])) > 0
+            if site == "before_fsync_release":
+                # Cut the crashed member's WAL tail: the bytes at risk
+                # are exactly the written-but-unfsynced wave nothing
+                # was acked from, plus (seed-chosen) possibly older
+                # fsync'd bytes — the fence + touch pass below must
+                # re-heal either way with zero acked loss.
+                h.torn_tail(2)
+            acked = h.run_workload(6, prefix=b"mid")
+            assert acked >= 3  # quorum keeps committing without m2
+            h.restart(2)
+            h.wait_leaders()
+            h.touch_all_groups()
+            run_invariant_checks(h, obs, expect_members=R)
+        finally:
+            obs.stop()
+            h.stop()
+
+
+class TestStopDrain:
+    def test_stop_during_pending_fsync_drains_deterministically(
+            self, tmp_path):
+        """Satellite regression: stop() must drain/fence the pipeline
+        deterministically — no fsync racing a closed WAL handle, no
+        deadlock on a worker mid-window, every pending wave flushed
+        before the handle closes."""
+        # Dwell kept well under the election timeout (10 ticks x 20ms):
+        # every send rides the release barrier, vote responses
+        # included, so a dwell rivaling the timeout starves elections
+        # (documented knob hazard — see hosting.py).
+        c = MultiRaftCluster(str(tmp_path), num_members=R, num_groups=G,
+                             cfg=CFG, wal_pipeline=True,
+                             wal_group_max_delay=0.05)
+        acked = {}
+        try:
+            leads = c.wait_leaders()
+            for g in range(G):
+                c.put(g, b"sk", b"sv%d" % g, timeout=30.0)
+                acked[g] = b"sv%d" % g
+            # Pin every worker inside the append->fsync window and
+            # leave un-awaited proposals in flight, so stop() overlaps
+            # an in-flight wave AND pending submissions.
+            for m in c.members.values():
+                failpoint.enable(m._fp_before_release, "sleep(150)")
+            for g in range(G):
+                c.members[int(leads[g])].propose(
+                    g, b"P" + b"late" + b"\x00" + b"x")
+            time.sleep(0.05)
+        finally:
+            t0 = time.monotonic()
+            c.stop()
+            stop_s = time.monotonic() - t0
+            failpoint.disable_all()
+        assert stop_s < 30.0, f"stop() wedged for {stop_s:.1f}s"
+        for m in c.members.values():
+            assert m._wal_closed, f"member {m.id}: WAL left open"
+            assert not m._wal_pending, (
+                f"member {m.id}: {len(m._wal_pending)} waves undrained")
+            assert m._wal_worker is not None
+            assert not m._wal_worker.is_alive()
+        # Replay: everything acked before stop survives the restart
+        # (the pending waves were flushed at stop, not torn away).
+        c2 = MultiRaftCluster(str(tmp_path), num_members=R,
+                              num_groups=G, cfg=CFG, wal_pipeline=True)
+        try:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if all(m.get(g, b"sk") == v
+                       for m in c2.members.values()
+                       for g, v in acked.items()):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    "acked writes lost across stop+replay")
+        finally:
+            c2.stop()
+
+
+class TestPipelineChaos:
+    def test_msg_faults_crash_restart_lockorder_strict(self, tmp_path):
+        """Pipeline-on re-fly of the quick chaos bar over TCP: lossy
+        links, a kill mid-flight, restart through _replay — strict
+        3-checker close with invariant_trips()==0 — while the
+        lock-order sentinel records the WAL-commit worker against the
+        member/drain/pump/TCP-sender threads (the new thread must slot
+        into the documented _lock -> {_wal_io, _wal_cv} hierarchy
+        without a cycle)."""
+        rec = LockOrderRecorder(
+            "walpipe-chaos", include=lambda p: "etcd_tpu" in p)
+        rec.enable()
+        try:
+            h = ChaosHarness(str(tmp_path), SEEDS[0], MSG_FAULTS,
+                             num_members=R, num_groups=G, cfg=CFG,
+                             transport="tcp", wal_pipeline=True)
+            obs = LeaderObserver(h.alive)
+            try:
+                h.wait_leaders()
+                obs.start()
+                acked = h.run_workload(8)
+                assert acked >= 4, f"only {acked}/8 writes acked"
+                h.crash(2)
+                h.restart(2)
+                h.wait_leaders()
+                h.run_workload(4, prefix=b"post")
+                h.plan.quiesce()
+                run_invariant_checks(h, obs, expect_members=R)
+            finally:
+                obs.stop()
+                h.stop()
+        finally:
+            rec.disable()
+        assert rec.sites, "recorder saw no etcd_tpu locks"
+        assert rec.edges, "no nested acquisitions recorded"
+        rec.check()
+
+
+class TestGroupCommit:
+    def test_coverage_and_metrics(self, tmp_path):
+        """The amortization the pipeline exists for: with a dwell
+        window armed, one fsync covers multiple device rounds'
+        persistence batches, the health op reports the ratio, and the
+        etcd_tpu_wal_pipeline_* families land on the shared registry
+        (dump_metrics --watch picks them up from there)."""
+        c = MultiRaftCluster(str(tmp_path), num_members=R, num_groups=G,
+                             cfg=CFG, wal_pipeline=True,
+                             wal_group_max_delay=0.05)
+        try:
+            c.wait_leaders()
+            for i in range(12):
+                c.put(i % G, b"c%d" % i, b"v%d" % i, timeout=30.0)
+            hp = c.members[1].health()["wal_pipeline"]
+            assert hp["enabled"]
+            assert hp["fsyncs"] > 0
+            assert hp["rounds_per_fsync"] > 1.0, hp
+            text = pmet.DEFAULT.expose()
+            for fam in ("etcd_tpu_wal_pipeline_queue_depth",
+                        "etcd_tpu_wal_pipeline_batches_per_fsync",
+                        "etcd_tpu_wal_pipeline_bytes_per_fsync",
+                        "etcd_tpu_wal_pipeline_ack_release_seconds"):
+                assert fam in text, f"{fam} not registered"
+        finally:
+            c.stop()

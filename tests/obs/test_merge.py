@@ -20,11 +20,12 @@ MS = 1_000_000  # ns
 # (net == commit - peer_send), so the NTP-style estimator is exact.
 ORIGIN_STAGES = {
     "propose": 0 * MS, "stage": 1 * MS, "dispatch": 2 * MS,
-    "extract": 3 * MS, "fsync": 4 * MS, "send": 5 * MS,
-    "commit": 9 * MS, "apply": 10 * MS,
+    "extract": 3 * MS, "fsync_wait": 4 * MS, "fsync": 5 * MS,
+    "send": 6 * MS, "commit": 11 * MS, "apply": 12 * MS,
 }
 NET = 1 * MS
-PEER_TRUE = {"extract": 6 * MS, "fsync": 7 * MS, "send": 8 * MS}
+PEER_TRUE = {"extract": 7 * MS, "fsync_wait": 8 * MS,
+             "fsync": 9 * MS, "send": 10 * MS}
 # Member clock shifts: member m's monotonic clock reads true + shift.
 SHIFT = {"1": 0, "2": 5 * MS, "3": -3 * MS}
 
@@ -110,14 +111,15 @@ class TestHopDecomposition:
     def test_hop_values_match_ground_truth(self):
         stats = hop_stats(synthetic_payloads())
         expect_ms = {
-            "enqueue_wait": 1, "stage": 1, "step": 1, "fsync": 1,
-            "send": 1, "net_to_peer": 1, "peer_fsync": 1,
+            "enqueue_wait": 1, "stage": 1, "step": 1, "fsync_wait": 1,
+            "fsync": 1, "send": 1, "net_to_peer": 1,
+            "peer_fsync_wait": 1, "peer_fsync": 1,
             "peer_ack": 1, "ack_to_commit": 1, "apply": 1,
         }
         for name, ms in expect_ms.items():
             assert stats["hops"][name]["p50_ms"] == pytest.approx(ms), name
-        assert stats["e2e_commit"]["p50_ms"] == pytest.approx(9.0)
-        assert stats["e2e_apply"]["p50_ms"] == pytest.approx(10.0)
+        assert stats["e2e_commit"]["p50_ms"] == pytest.approx(11.0)
+        assert stats["e2e_apply"]["p50_ms"] == pytest.approx(12.0)
 
     def test_quorum_peer_is_the_fastest_ack(self):
         """With one peer slower by 2ms (skew-corrected), the

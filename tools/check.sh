@@ -4,8 +4,9 @@
 # order) + trace smoke (one traced in-proc round, exporter validated)
 # + fleet smoke (tiny in-proc cluster with the fleet observatory on,
 # fleet_console --once --json validated) + rebalance smoke (seeded
-# leader skew, rebalancerd --once --json must converge it) +
-# bench-history re-emit. CI
+# leader skew, rebalancerd --once --json must converge it) + walpipe
+# smoke (async group-commit WAL pipeline: fsync coverage > 1, clean
+# stop-drain replay) + bench-history re-emit. CI
 # runs exactly this script
 # (.github/workflows/lint.yml); run it locally before pushing anything
 # that touches the batched hot path.
@@ -36,6 +37,9 @@ python tools/fleet_smoke.py
 
 echo "== rebalance smoke (seeded leader skew, rebalancerd --once --json) =="
 python tools/rebalance_smoke.py
+
+echo "== walpipe smoke (async group-commit WAL pipeline, fsync coverage > 1) =="
+python tools/walpipe_smoke.py
 
 echo "== bench history (artifacts/bench_history.json + BENCH_HISTORY.md) =="
 python tools/bench_history.py

@@ -112,6 +112,12 @@ def collect(addrs: List[str], timeout: float = 10.0,
                          if hl.get("ok") else None),
             "conf_applied": (hl.get("conf_applied", 0)
                              if hl.get("ok") else None),
+            # Async WAL pipeline (ISSUE 13): group-commit amortization
+            # ratio (device rounds per fsync) + live queue depth from
+            # the health op; None when the member predates the field,
+            # {"enabled": False, ...} when it runs inline persistence.
+            "wal_pipeline": (hl.get("wal_pipeline")
+                             if hl.get("ok") else None),
             "router_loss": (_sum_numeric(st.get("router", {}))
                             if st.get("ok") else None),
         })
@@ -202,19 +208,23 @@ def render(data: Dict, top: int = 8) -> str:
         "",
         f"{'member':>8} {'frames':>8} {'leaders':>8} {'fenced':>7} "
         f"{'joint':>6} {'lrnr':>5} "
-        f"{'lag max':>8} {'inv':>5} {'loss':>6}  wal tail / state",
+        f"{'lag max':>8} {'inv':>5} {'loss':>6} {'r/fsync':>8}  "
+        f"wal tail / state",
     ]
     for mid in sorted(data["members"]):
         m = data["members"][mid]
         if "err" in m:
             lines.append(f"{mid:>8} ERR {m['err']}")
             continue
+        wp = m.get("wal_pipeline") or {}
+        rpf = (f"{wp.get('rounds_per_fsync', 0):.1f}"
+               if wp.get("enabled") else "-")
         lines.append(
             f"{m['member']:>8} {m['frames']:>8} {m['leaders']:>8} "
             f"{m['fenced']:>7} {str(m.get('joint')):>6} "
             f"{str(m.get('learners')):>5} {m['lag_max']:>8} "
             f"{str(m['invariant_trips']):>5} "
-            f"{str(m['router_loss']):>6}  {m['wal_tail']}")
+            f"{str(m['router_loss']):>6} {rpf:>8}  {m['wal_tail']}")
     lines.append("")
     lines.append(f"top-{top} laggards (cluster-wide):")
     if cl["top"]:

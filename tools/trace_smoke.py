@@ -51,6 +51,7 @@ def main() -> int:
                         rns[to].step_block(sub)
                 for row, m in rd.messages:
                     rns[m.to].step(row, m)
+                rn.tracer.stamp_many(rd.traced_entries, "fsync_wait")
                 rn.tracer.stamp_many(rd.traced_entries, "fsync")
                 rn.tracer.stamp_many(rd.traced_entries, "send")
                 rn.tracer.stamp_many(rd.traced_commit, "apply")
