@@ -81,14 +81,14 @@ def _ensure_live_backend() -> None:
 
 
 def _make_engine(groups: int, lanes_minor: bool,
-                 merged_deliver: bool = False,
+                 deliver_shape: str = "auto",
                  telemetry: bool = False,
                  fleet: bool = False):
     # Canonical config + setup shared with tools/frontier_sweep.py so
     # the two tools' numbers stay methodologically comparable.
     from etcd_tpu.tools.benchlib import make_bench_engine
 
-    return make_bench_engine(groups, lanes_minor, merged_deliver,
+    return make_bench_engine(groups, lanes_minor, deliver_shape,
                              telemetry=telemetry, fleet=fleet)
 
 
@@ -123,15 +123,20 @@ def main() -> None:
     layout_env = os.environ.get("BENCH_LAYOUT", "")
     if layout_env and layout_env not in ("major", "minor"):
         raise SystemExit(f"BENCH_LAYOUT must be major|minor, got {layout_env!r}")
-    # Deliver-scan shape: the round-5 on-TPU measurement batch showed
-    # the two merged request/response scans 1.044x the six per-kind
-    # scans on TPU v5 lite (BENCH_NOTES r05; CPU prefers six ~2x), so
-    # accelerators take the merged shape unless pinned otherwise.
-    merged_env = os.environ.get("BENCH_MERGED_DELIVER", "")
-    if merged_env and merged_env not in ("0", "1"):
+    # Deliver shape (ISSUE 14 A/B axis): the platform default lives in
+    # state.default_deliver_shape (CPU → vectorized, the r14 same-day
+    # winner; TPU → merged, the only on-device-tuned shape, r05).
+    # BENCH_DELIVER_SHAPE=lanes|merged|vectorized pins it for A/B rows.
+    shape_env = os.environ.get("BENCH_DELIVER_SHAPE", "")
+    if os.environ.get("BENCH_MERGED_DELIVER", ""):
         raise SystemExit(
-            f"BENCH_MERGED_DELIVER must be 0|1, got {merged_env!r}")
-    merged = (merged_env == "1") if merged_env else accelerated
+            "BENCH_MERGED_DELIVER was replaced by "
+            "BENCH_DELIVER_SHAPE=lanes|merged|vectorized (ISSUE 14)")
+    if shape_env and shape_env not in ("lanes", "merged", "vectorized"):
+        raise SystemExit(
+            "BENCH_DELIVER_SHAPE must be lanes|merged|vectorized, "
+            f"got {shape_env!r}")
+    deliver_shape = shape_env or "auto"
     pipe_env = os.environ.get("BENCH_PIPELINE", "")
     if pipe_env and pipe_env not in ("0", "1"):
         raise SystemExit(f"BENCH_PIPELINE must be 0|1, got {pipe_env!r}")
@@ -169,8 +174,9 @@ def main() -> None:
         for lm in (False, True):
             try:
                 t0 = time.perf_counter()
-                engines[lm] = _make_engine(min(groups, 4096), lm, merged,
-                                           telemetry, fleet)
+                engines[lm] = _make_engine(min(groups, 4096), lm,
+                                           deliver_shape, telemetry,
+                                           fleet)
                 _note(f"probe layout={'minor' if lm else 'major'} "
                       f"built+compiled in {time.perf_counter()-t0:.1f}s")
                 rates[lm] = _rate(*engines[lm], 8, 2)
@@ -189,15 +195,15 @@ def main() -> None:
     else:
         try:
             t0 = time.perf_counter()
-            eng, props = _make_engine(groups, lanes_minor, merged,
-                                      telemetry, fleet)
+            eng, props = _make_engine(groups, lanes_minor,
+                                      deliver_shape, telemetry, fleet)
         except Exception as e:  # noqa: BLE001 — one-shot layout fallback
             _note(f"layout={'minor' if lanes_minor else 'major'} failed "
                   f"({e!r}); falling back to the other layout")
             lanes_minor = not lanes_minor
             t0 = time.perf_counter()
-            eng, props = _make_engine(groups, lanes_minor, merged,
-                                      telemetry, fleet)
+            eng, props = _make_engine(groups, lanes_minor,
+                                      deliver_shape, telemetry, fleet)
         _note(f"main G={groups} built+compiled in {time.perf_counter()-t0:.1f}s")
     rate = _rate(eng, props, 16, 8, pipelined=pipelined)
     _note(f"main rate: {rate:.0f} group-rounds/s")
@@ -216,7 +222,7 @@ def main() -> None:
                 "unit": (
                     f"group-rounds/s ({platform}, G={groups}, R=3, "
                     f"layout={'minor' if lanes_minor else 'major'}, "
-                    f"deliver={'merged' if merged else 'six'}, "
+                    f"deliver={eng.cfg.deliver_shape}, "
                     f"loop={'pipelined' if pipelined else 'serial'}, "
                     f"telemetry={'on' if telemetry else 'off'}, "
                     f"fleet={'on' if fleet else 'off'}, "

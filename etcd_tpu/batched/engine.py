@@ -34,7 +34,10 @@ from .step import MsgSlots, NUM_KINDS, empty_msgs, make_step_round, route
 
 class MultiRaftEngine:
     def __init__(self, cfg: BatchedConfig, start_index: int = 0):
-        self.cfg = cfg.validate()
+        # deliver_shape="auto" resolves to the platform default here
+        # (state.default_deliver_shape), so self.cfg always names the
+        # concrete shape the compiled round actually runs.
+        self.cfg = cfg = cfg.validate().resolved()
         # Round programs are expensive to build (minutes over the
         # remote-compile tunnel); cache compilations across processes
         # unless ETCD_TPU_COMPILE_CACHE=off.
@@ -43,6 +46,7 @@ class MultiRaftEngine:
         self.inbox = empty_msgs(
             (cfg.num_instances, cfg.num_replicas, NUM_KINDS),
             cfg.max_ents_per_msg,
+            narrow=cfg.narrow_lanes,
         )
         self._step = make_step_round(cfg)
         n = cfg.num_instances

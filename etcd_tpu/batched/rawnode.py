@@ -216,7 +216,10 @@ class BatchedRawNode:
         start_index: int = 0,
         mesh: Optional["object"] = None,
     ) -> None:
-        self.cfg = cfg.validate()
+        # Resolve deliver_shape="auto" to the platform default so the
+        # hosted path and the closed-loop engine pick the same compiled
+        # round program for one logical config.
+        self.cfg = cfg = cfg.validate().resolved()
         from .compile_cache import enable_compile_cache
 
         enable_compile_cache()
@@ -258,6 +261,11 @@ class BatchedRawNode:
         self._slots_j = dev(slots)
         self._step = make_step_round(
             cfg, iids=dev(iids), slots=self._slots_j, with_aux=True,
+            # Mesh-sharded rows must not pay a cross-shard collective
+            # for the lane-occupancy skip (step._step_round_jit): the
+            # sharded round's contract is ZERO collectives on the hot
+            # path, and concurrent members' AllReduces deadlock.
+            lane_skip=self._shard is None,
         )
         # Transfer-guard warmth is per (config, row count): the shared
         # round program recompiles per distinct row shape, and compiles
@@ -1082,14 +1090,20 @@ class BatchedRawNode:
         r, e = cfg.num_replicas, cfg.max_ents_per_msg
         shape = (self.n, r, NUM_KINDS)
         valid = np.zeros(shape, bool)
-        typ = np.zeros(shape, np.int32)
+        # Bounded lanes stage at their narrow storage dtypes under
+        # cfg.narrow_lanes (step.NARROW_MSG_DTYPES: wire types < 32,
+        # n_ents <= 255) so the staged inbox matches the dtype the
+        # compiled round expects; the kernel widens at deliver entry.
+        typ = np.zeros(shape,
+                       np.int8 if cfg.narrow_lanes else np.int32)
         term = np.zeros(shape, np.int32)
         log_term = np.zeros(shape, np.int32)
         index = np.zeros(shape, np.int32)
         commit = np.zeros(shape, np.int32)
         reject = np.zeros(shape, bool)
         reject_hint = np.zeros(shape, np.int32)
-        n_ents = np.zeros(shape, np.int32)
+        n_ents = np.zeros(shape,
+                          np.int16 if cfg.narrow_lanes else np.int32)
         ctx = np.zeros(shape, np.int32)
         ent_terms = np.zeros(shape + (e,), np.int32)
         dead = []

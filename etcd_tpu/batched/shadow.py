@@ -119,12 +119,20 @@ class ShadowCluster:
         deterministic_timeouts: bool = False,
         auto_compact_window: int = 0,
         max_ents: Optional[int] = None,
-        merged_deliver: bool = False,
+        deliver_shape: str = "auto",
     ):
-        # Mirrors BatchedConfig.merged_deliver: the device's delivery
-        # order is kind-major (six lane scans) or sender-major within
-        # request/response halves (two merged scans).
-        self.merged_deliver = merged_deliver
+        # Mirrors BatchedConfig.deliver_shape: the device's delivery
+        # order is kind-major (six lane scans, "lanes"), sender-major
+        # within request/response halves ("merged"), or the vectorized
+        # order contract ("vectorized" — see _deliver_vectorized_target
+        # below). "auto" resolves to the same platform default the
+        # engine resolves, so default-config engine↔shadow pairs always
+        # agree on the order.
+        if deliver_shape == "auto":
+            from .state import default_deliver_shape
+
+            deliver_shape = default_deliver_shape()
+        self.deliver_shape = deliver_shape
         self.r = num_replicas
         self.nodes: List[RawNode] = []
         lrn = {s + 1 for s in learners}
@@ -181,17 +189,18 @@ class ShadowCluster:
         drops = set(drop_pairs)
 
         # Phase 1: deliver in the exact order of the device's
-        # configured scan shape (step.py _deliver_all): kind-major for
-        # the six lane scans, or request/response halves sender-major
-        # for the two merged scans.
-        if self.merged_deliver:
+        # configured deliver shape (step.py _deliver_all): kind-major
+        # for the six lane scans ("lanes"), request/response halves
+        # sender-major for the two merged scans ("merged"), or the
+        # vectorized order contract ("vectorized").
+        if self.deliver_shape == "merged":
             order = [
                 (sender, kind)
                 for kinds in (range(0, 3), range(3, NUM_KINDS))
                 for sender in range(self.r)
                 for kind in kinds
             ]
-        else:
+        else:  # "lanes" (the vectorized path orders per target below)
             order = [
                 (sender, kind)
                 for kind in range(NUM_KINDS)
@@ -200,6 +209,9 @@ class ShadowCluster:
         inbox, self.inbox = self.inbox, self._empty_inbox()
         for target in range(self.r):
             if target in iso:
+                continue
+            if self.deliver_shape == "vectorized":
+                self._deliver_vectorized_target(target, inbox[target])
                 continue
             for sender, kind in order:
                 m = inbox[target][sender][kind]
@@ -331,6 +343,61 @@ class ShadowCluster:
         for slot, rd in readys:
             self.nodes[slot].advance(rd)
 
+
+    def _deliver_vectorized_target(self, target: int, msgs) -> None:
+        """One target's inbox in the vectorized shape's order contract
+        (step.py _deliver_vectorized): lanes in kind order; within the
+        vote lane every T_VOTE (term desc, sender asc) before every
+        T_PREVOTE (prevotes never mutate state); within the other
+        request lanes the winner (term desc, sender asc) first, losers
+        after — a loser the winner has not made stale would apply here
+        but is dropped on device, so it raises as an envelope
+        violation (two leaders at one term cannot exist in-protocol);
+        within response lanes same-term effects first (commutative),
+        then deposing messages ascending by term."""
+        node = self.nodes[target]
+
+        def step(m: Message) -> None:
+            try:
+                node.step(m)
+            except RaftError:
+                pass
+
+        def lane(kind):
+            return [(s, msgs[s][kind]) for s in range(self.r)
+                    if msgs[s][kind] is not None]
+
+        votes = sorted(
+            (x for x in lane(KIND_VOTE)
+             if x[1].type == MessageType.MsgVote),
+            key=lambda sm: (-sm[1].term, sm[0]))
+        pres = [x for x in lane(KIND_VOTE)
+                if x[1].type != MessageType.MsgVote]
+        for _, m in votes + pres:
+            step(m)
+
+        for kind in (KIND_APP, KIND_HB):
+            ordered = sorted(lane(kind),
+                             key=lambda sm: (-sm[1].term, sm[0]))
+            for i, (sender, m) in enumerate(ordered):
+                if i > 0 and m.term >= node.raft.term:
+                    raise AssertionError(
+                        f"vectorized deliver: request-lane loser from "
+                        f"{sender} at term {m.term} not stale against "
+                        f"the winner (node term {node.raft.term}); "
+                        "schedule outside the vectorized envelope")
+                step(m)
+
+        for kind in (KIND_VOTE_RESP, KIND_APP_RESP, KIND_HB_RESP):
+            t0 = node.raft.term
+            eff, dep = [], []
+            for s, m in lane(kind):
+                deposes = m.term > t0 and not (
+                    m.type == MessageType.MsgPreVoteResp and not m.reject)
+                (dep if deposes else eff).append((s, m))
+            dep.sort(key=lambda sm: (sm[1].term, sm[0]))
+            for _, m in eff + dep:
+                step(m)
 
     def _rematerialize(self, node: RawNode, m: Message) -> Message:
         """The device remembers only a send FLAG per peer and derives

@@ -35,6 +35,30 @@ I8 = jnp.int8
 # count on the wire (E=256 reads back as 0 entries).
 MAX_WIRE_ENTS = 255
 
+# The shippable deliver shapes (BatchedConfig.deliver_shape); "auto"
+# resolves to one of these per platform at build time.
+DELIVER_SHAPES = ("lanes", "merged", "vectorized")
+
+
+def default_deliver_shape() -> str:
+    """Platform default for deliver_shape="auto".
+
+    CPU takes the vectorized shape (the ISSUE 14 same-day A/B winner —
+    BENCH_NOTES r14); TPU-class backends keep the merged scans, the
+    only shape ever tuned ON DEVICE (+4.4% vs lanes, BENCH_NOTES r5) —
+    the r5 lesson is that CPU predictions invert on TPU, so vectorized
+    must win a tools/tpu_batch.py --deliver-shape run over the live
+    tunnel before it becomes the accelerator default. Anything else
+    (unknown plugin) falls back to the original six lane scans."""
+    import jax
+
+    platform = jax.default_backend()
+    if platform == "cpu":
+        return "vectorized"
+    if platform in ("tpu", "axon"):
+        return "merged"
+    return "lanes"
+
 
 class BatchedConfig(NamedTuple):
     """Static (compile-time) engine configuration."""
@@ -60,14 +84,32 @@ class BatchedConfig(NamedTuple):
     # stays [N, ...]; the jitted round transposes at entry/exit.
     # bench.py probes both layouts and picks the faster one per device.
     lanes_minor: bool = False
-    # Deliver-scan shape: False = six length-R scans (one per kind
-    # lane, kind-major order); True = two length-R scans (request and
-    # response halves, sender-major order) with 3x bigger fused bodies.
-    # Semantically equivalent protocols with DIFFERENT delivery orders
-    # (the shadow oracle mirrors whichever is set). CPU favors the six
-    # small scans ~2x; the merged shape exists for TPU measurement,
-    # where per-iteration overhead, not vector width, bounds the round.
-    merged_deliver: bool = False
+    # Deliver shape: how one instance's [R, K] inbox is folded into
+    # state (step.py _deliver_all). Semantically equivalent protocols
+    # with DIFFERENT delivery orders (the shadow oracle mirrors
+    # whichever is set; see step.py for each shape's order contract):
+    #
+    # * "lanes":      six length-R lax.scans, one per kind lane,
+    #                 senders ascending (kind-major). Small bodies.
+    # * "merged":     two length-R scans (request/response halves,
+    #                 sender-major), 3x bigger fused bodies, a third of
+    #                 the loop-carry round trips. The r5 on-TPU winner
+    #                 (+4.4% vs lanes) — kept as the accelerator
+    #                 fallback and differential baseline.
+    # * "vectorized": NO sender scan. Response lanes fold as masked
+    #                 segment reductions over the sender axis (one
+    #                 commit recompute per lane); request lanes resolve
+    #                 one effective winner per lane via a (term,
+    #                 sender) tournament and apply the handler body
+    #                 once, losers answered with scattered stale
+    #                 nudges. The whole round is then one straight-line
+    #                 fused region — no scan barriers between phases.
+    # * "auto":       resolved per platform at engine/rawnode build
+    #                 time (resolve_deliver_shape): CPU → vectorized,
+    #                 TPU/axon → merged until tools/tpu_batch.py
+    #                 --deliver-shape re-tunes ON DEVICE (the r5
+    #                 lesson: CPU predictions inverted on TPU).
+    deliver_shape: str = "auto"
     # Store the bounded hot lanes (role/vote/lead enums, vote tallies,
     # progress states, inflight counts) in int8/int16 between rounds:
     # the round kernel widens them to i32 at entry and narrows at exit,
@@ -117,7 +159,20 @@ class BatchedConfig(NamedTuple):
             raise ValueError(
                 f"max_inflight={self.max_inflight} does not fit the "
                 "int16 inflight lane; lower it or disable narrow_lanes")
+        if self.deliver_shape not in ("auto",) + DELIVER_SHAPES:
+            raise ValueError(
+                f"deliver_shape={self.deliver_shape!r} not in "
+                f"{('auto',) + DELIVER_SHAPES}")
         return self
+
+    def resolved(self) -> "BatchedConfig":
+        """Resolve deliver_shape="auto" to the platform default. Every
+        engine/rawnode/step builder resolves BEFORE keying a compile
+        (step._step_round_jit caches per config), so "auto" and its
+        concrete resolution share one program."""
+        if self.deliver_shape != "auto":
+            return self
+        return self._replace(deliver_shape=default_deliver_shape())
 
 
 class BatchedState(NamedTuple):

@@ -19,7 +19,8 @@ routing between instances of the same group is a single transpose over
 the (sender, target) axes — no scatters, no host round-trips. A round
 is one jitted program:
 
-    deliver (2 sender scans: requests then responses) → tick → propose → emit → route
+    deliver (shape-configured: lane scans, merged scans, or the
+    scan-free vectorized fold) → tick → control → propose → emit → route
 
 Determinism: randomized election timeouts use a per-instance hash of
 (instance id, reset count), reproducible by the host oracle for
@@ -68,11 +69,26 @@ from .state import (
 
 # Message kinds = inbox slot layout (capacity classes, not semantics: a
 # slot of a response kind may carry a stale-term MsgAppResp; handlers
-# dispatch on the type field). The response to a kind-k request routes
-# back in lane 3+k, so lane 3 carries vote responses, lane 4 append
-# responses, lane 5 heartbeat responses.
+# dispatch on the type field).
+#
+# THE INBOX LANE-ORDER CONTRACT (one constant, three consumers): the
+# first NUM_REQ_KINDS lanes carry requests, and the response to a
+# kind-k request routes back in lane ``k + NUM_REQ_KINDS`` — lane 3
+# carries vote responses, lane 4 append responses, lane 5 heartbeat
+# responses. Everything that splits or scatters lanes derives from
+# NUM_REQ_KINDS: the deliver shapes' request/response split, the
+# round's response scatter (``out[:, NUM_REQ_KINDS:]`` in
+# _step_round_jit), and route()'s no-op on lane indexes (responses are
+# already placed in their response lane BEFORE the transpose). The
+# msgblock↔step differential test pins the contract
+# (tests/batched/test_msgblock.py), so a drifted call site fails a
+# test instead of silently crossing lanes.
 KIND_VOTE, KIND_APP, KIND_HB, KIND_VOTE_RESP, KIND_APP_RESP, KIND_HB_RESP = range(6)
 NUM_KINDS = 6
+NUM_REQ_KINDS = 3
+assert (KIND_VOTE_RESP, KIND_APP_RESP, KIND_HB_RESP) == tuple(
+    k + NUM_REQ_KINDS for k in (KIND_VOTE, KIND_APP, KIND_HB)
+), "response lanes must sit exactly NUM_REQ_KINDS above their requests"
 
 # Wire types (values match etcd_tpu.raft.types.MessageType).
 T_APP, T_APP_RESP = 3, 4
@@ -118,13 +134,45 @@ class MsgSlots(NamedTuple):
     ent_terms: jnp.ndarray  # i32 [..., E]
 
 
-def empty_msgs(shape: Tuple[int, ...], num_ents: int) -> MsgSlots:
+# Narrow storage dtype per bounded message lane (cfg.narrow_lanes),
+# the MsgSlots twin of state.NARROW_DTYPES: wire types are < 32 (int8),
+# per-message entry counts are <= MAX_WIRE_ENTS = 255 (int16; int8 is
+# signed and would wrap at 128). valid/reject are already bool. The
+# unbounded protocol words (term/index/commit/log_term/reject_hint/
+# ent_terms, plus ctx which carries read_seq) stay int32 — narrowing a
+# watermark would change wrap semantics. Narrow lanes live ONLY in the
+# between-rounds carry (the routed inbox / emitted outbox); the round
+# kernel widens at deliver entry and narrows at emit exit, so handler
+# math is bit-identical to the wide layout (the jitlint narrow-lane
+# contract, mirroring state.widen_state/narrow_state).
+NARROW_MSG_DTYPES = {
+    "type": jnp.int8,
+    "n_ents": jnp.int16,
+}
+
+
+def narrow_msgs(m: MsgSlots) -> MsgSlots:
+    """Cast the bounded message lanes to their narrow storage dtypes."""
+    return m._replace(**{
+        f: getattr(m, f).astype(dt) for f, dt in NARROW_MSG_DTYPES.items()
+    })
+
+
+def widen_msgs(m: MsgSlots) -> MsgSlots:
+    """Cast narrow message lanes back to i32 for the round kernel."""
+    return m._replace(**{
+        f: getattr(m, f).astype(I32) for f in NARROW_MSG_DTYPES
+    })
+
+
+def empty_msgs(shape: Tuple[int, ...], num_ents: int,
+               narrow: bool = False) -> MsgSlots:
     # One fresh buffer per field (no aliasing): the round loop donates
     # its inbox, and a buffer appearing under two leaves of a donated
     # pytree is a runtime error ("attempt to donate the same buffer
     # twice"). Inside a trace these are constants either way.
     z = lambda: jnp.zeros(shape, I32)  # noqa: E731
-    return MsgSlots(
+    m = MsgSlots(
         valid=jnp.zeros(shape, bool),
         type=z(),
         term=z(),
@@ -137,6 +185,7 @@ def empty_msgs(shape: Tuple[int, ...], num_ents: int) -> MsgSlots:
         ctx=z(),
         ent_terms=jnp.zeros(shape + (num_ents,), I32),
     )
+    return narrow_msgs(m) if narrow else m
 
 
 def _sel(cond, a, b):
@@ -780,25 +829,45 @@ _LANE_HANDLERS = (
 
 
 def _deliver_all(cfg: BatchedConfig, iid, slot, st: BatchedState,
-                 inbox: MsgSlots):
-    """Deliver this instance's inbox; the scan shape is configured:
+                 inbox: MsgSlots, lane_any=None):
+    """Deliver this instance's inbox; the shape is configured
+    (cfg.deliver_shape — see state.BatchedConfig for the catalog):
 
-    * ``merged_deliver=False`` (default): six length-R scans, one per
-      kind lane, senders ascending within a lane (kind-major order).
-      Small bodies; CPU-friendly.
-    * ``merged_deliver=True``: two length-R scans — request half
-      (kinds 0..2) then response half — each body chaining the three
-      kind handlers for one sender (sender-major order within a half).
-      Same 18 handler applications, 3x bigger fused bodies, a third of
-      the loop-carry round trips; built for TPU, where per-iteration
-      overhead bounds the round.
+    * ``"lanes"``: six length-R scans, one per kind lane, senders
+      ascending within a lane (kind-major order). Small bodies.
+    * ``"merged"``: two length-R scans — request half (kinds
+      0..NUM_REQ_KINDS-1) then response half — each body chaining the
+      three kind handlers for one sender (sender-major order within a
+      half). Same 18 handler applications, 3x bigger fused bodies, a
+      third of the loop-carry round trips; the r5 on-TPU winner.
+    * ``"vectorized"``: NO sender scan (see _deliver_vectorized) —
+      response lanes fold as masked reductions, request lanes resolve
+      one winner per lane, and the full BatchedState stops round-
+      tripping through a loop carry 6R (or 2R) times per round.
 
-    Either way, responses are collected for the request lanes 0..2 and
-    route back in lanes 3..5, and the shadow oracle replicates the
-    exact delivery order of the configured shape."""
-    if cfg.merged_deliver:
+    Every shape collects responses for the request lanes and routes
+    them back in lanes ``k + NUM_REQ_KINDS``, and the shadow oracle
+    replicates the exact delivery order of the configured shape.
+
+    ``lane_any`` ([K] bool, optional) is the vectorized shape's
+    batch-level lane-occupancy vector: the CALLER computes
+    ``jnp.any(inbox.valid, axis=(0, 1))`` OUTSIDE the instance vmap so
+    each lane's fold sits under a lax.cond with an UNMAPPED predicate
+    — a lane nobody used this round (votes in steady state, heartbeat
+    lanes off-cadence) costs nothing instead of a full masked no-op.
+    An all-invalid lane is an exact identity, so the skip is
+    bit-equivalent; None falls back to per-instance occupancy (the
+    cond degrades to a select under a mapped predicate — correct,
+    just unskipped)."""
+    if cfg.deliver_shape == "vectorized":
+        return _deliver_vectorized(cfg, iid, slot, st, inbox, lane_any)
+    if cfg.deliver_shape == "merged":
         return _deliver_merged(cfg, iid, slot, st, inbox)
-    return _deliver_lanes(cfg, iid, slot, st, inbox)
+    if cfg.deliver_shape == "lanes":
+        return _deliver_lanes(cfg, iid, slot, st, inbox)
+    raise ValueError(
+        f"unresolved deliver_shape {cfg.deliver_shape!r}: call "
+        "cfg.resolved() before building a round program")
 
 
 def _deliver_lanes(cfg: BatchedConfig, iid, slot, st: BatchedState,
@@ -809,7 +878,7 @@ def _deliver_lanes(cfg: BatchedConfig, iid, slot, st: BatchedState,
     req_resps = []
     for k, handler in enumerate(_LANE_HANDLERS):
         msgs_k = jax.tree.map(lambda x, _k=k: x[:, _k], inbox)  # [R, ...]
-        if k < 3:
+        if k < NUM_REQ_KINDS:
             def body(carry, xs, _h=handler):
                 m, s = xs
                 st2, resp = _h(cfg, iid, slot, carry, m, s)
@@ -836,12 +905,13 @@ def _deliver_merged(cfg: BatchedConfig, iid, slot, st: BatchedState,
     r = cfg.num_replicas
     senders = jnp.arange(r, dtype=I32)
 
-    req_inbox = jax.tree.map(lambda x: x[:, :3], inbox)  # [R, 3, ...]
+    req_inbox = jax.tree.map(
+        lambda x: x[:, :NUM_REQ_KINDS], inbox)  # [R, 3, ...]
 
     def req_body(carry, xs):
         msgs, s = xs  # msgs leaves: [3, ...]
         resps = []
-        for k, handler in enumerate(_LANE_HANDLERS[:3]):
+        for k, handler in enumerate(_LANE_HANDLERS[:NUM_REQ_KINDS]):
             m = jax.tree.map(lambda x, _k=k: x[_k], msgs)
             carry, resp = handler(cfg, iid, slot, carry, m, s)
             resps.append(resp)
@@ -849,17 +919,457 @@ def _deliver_merged(cfg: BatchedConfig, iid, slot, st: BatchedState,
 
     st, (r0, r1, r2) = jax.lax.scan(req_body, st, (req_inbox, senders))
 
-    resp_inbox = jax.tree.map(lambda x: x[:, 3:], inbox)  # [R, 3, ...]
+    resp_inbox = jax.tree.map(
+        lambda x: x[:, NUM_REQ_KINDS:], inbox)  # [R, 3, ...]
 
     def resp_body(carry, xs):
         msgs, s = xs
-        for k, handler in enumerate(_LANE_HANDLERS[3:]):
+        for k, handler in enumerate(_LANE_HANDLERS[NUM_REQ_KINDS:]):
             m = jax.tree.map(lambda x, _k=k: x[_k], msgs)
             carry = handler(cfg, iid, slot, carry, m, s)
         return carry, 0
 
     st, _ = jax.lax.scan(resp_body, st, (resp_inbox, senders))
 
+    # [R] per request lane → [R, 3].
+    req = jax.tree.map(
+        lambda a, b, c: jnp.stack((a, b, c), axis=1), r0, r1, r2
+    )
+    return st, req
+
+
+# -----------------------------------------------------------------------------
+# Vectorized deliver (cfg.deliver_shape == "vectorized"): no sender
+# scan. The protocol structure this exploits: per round each sender
+# contributes at most ONE message per lane, response-lane handlers are
+# order-invariant reductions over distinct progress columns (sender s
+# only ever touches column s; commit/read-quorum are single global
+# recomputes), and request lanes admit at most one effective winner
+# after term gating (one leader per term; votes record at most one
+# grant). Where the sequential scans' sender order DID matter — a
+# higher-term message deposing the receiver mid-lane — the vectorized
+# shape fixes its own order contract, mirrored exactly by the shadow
+# oracle (shadow.ShadowCluster deliver_shape="vectorized"):
+#
+#   * lanes still process in kind order 0..5;
+#   * request lanes: the winner (highest term, lowest sender) delivers
+#     first through the full handler; losers then answer against the
+#     post-winner state (stale nudges; equal-term losers cannot exist
+#     in-protocol — the shadow raises on them);
+#   * the vote lane orders T_VOTE (term desc, sender asc) before every
+#     T_PREVOTE (prevotes never change state, so they all evaluate
+#     against the post-vote state);
+#   * response lanes: same-term effects first (commutative), then the
+#     single highest-term depose, re-gated against the post-effect
+#     term.
+# -----------------------------------------------------------------------------
+
+
+def _argfirst(mask):
+    """Index of the first set bit of a [R] bool mask (0 if none)."""
+    return jnp.argmax(mask).astype(I32)
+
+
+def _gather_msg(msgs: MsgSlots, at) -> MsgSlots:
+    """msgs[w] for a traced winner index, as one-hot compare+reduce per
+    field (at = senders == w): traced-index gathers serialize on TPU,
+    one-hot reads don't (the _pick discipline, tree-wide)."""
+    def pick(x):
+        sel = at if x.ndim == 1 else at[:, None]
+        if x.dtype == jnp.bool_:
+            return jnp.any(x & sel, axis=0)
+        return jnp.sum(jnp.where(sel, x, 0), axis=0)
+
+    return jax.tree.map(pick, msgs)
+
+
+def _vec_lane_request(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                      m: MsgSlots, handler, hb_lane: bool):
+    """One request lane (KIND_APP / KIND_HB), vectorized: at most one
+    in-protocol message can take effect per (instance, lane) per round
+    (there is one leader per term, and only the highest term survives
+    the gate), so the winner — highest term, lowest sender — runs the
+    full per-message handler once, and every loser is answered with
+    the stale-leader nudge it would have received anyway, computed
+    against the post-winner state (ref: raft.go:885-905)."""
+    r = cfg.num_replicas
+    senders = jnp.arange(r, dtype=I32)
+    t_max = jnp.max(jnp.where(m.valid, m.term, -1))
+    at_w = senders == _argfirst(m.valid & (m.term == t_max))
+    mw = _gather_msg(m, at_w)
+    st2, wresp = handler(cfg, iid, slot, st, mw, _pick(senders, at_w))
+
+    nudge = (
+        m.valid & ~at_w & (m.term < st2.term)
+        & jnp.asarray(cfg.check_quorum or cfg.pre_vote)
+    )
+    if hb_lane:
+        # A losing MsgTimeoutNow never draws a response
+        # (ref: raft.go:885-905 applies to leader traffic only).
+        nudge = nudge & (m.type != T_TIMEOUT_NOW)
+    resp = empty_msgs((r,), cfg.max_ents_per_msg)
+    resp = resp._replace(
+        valid=jnp.where(at_w, wresp.valid, nudge),
+        type=jnp.where(at_w, wresp.type, T_APP_RESP),
+        term=jnp.where(at_w, wresp.term, st2.term),
+        log_term=jnp.where(at_w, wresp.log_term, 0),
+        index=jnp.where(at_w, wresp.index, 0),
+        commit=jnp.where(at_w, wresp.commit, 0),
+        reject=at_w & wresp.reject,
+        reject_hint=jnp.where(at_w, wresp.reject_hint, 0),
+        n_ents=jnp.where(at_w, wresp.n_ents, 0),
+        ctx=jnp.where(at_w, wresp.ctx, 0),
+        ent_terms=jnp.where(at_w[:, None], wresp.ent_terms[None, :], 0),
+    )
+    return st2, resp
+
+
+def _vec_lane_vote(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                   m: MsgSlots):
+    """Lane KIND_VOTE, vectorized. State effects come only from T_VOTE
+    at the highest surviving term: one depose (become_follower) and at
+    most one recorded grant — if the vote is already cast only its
+    holder can re-grant; if it is free the first up-to-date sender
+    takes it (sender-ascending, exactly the sequential setdefault).
+    Prevotes never mutate state, so all prevote responses evaluate
+    against the post-vote state in one masked shot."""
+    r = cfg.num_replicas
+    senders = jnp.arange(r, dtype=I32)
+    is_vote = m.type == T_VOTE
+    is_pre = m.type == T_PREVOTE
+
+    # Leases block higher-term requests unless transfer-flagged
+    # (ref: raft.go:870-880); evaluated against lane-entry state for
+    # T_VOTE (the winner is the first message delivered).
+    def lease_block(stx):
+        in_lease = (
+            jnp.asarray(cfg.check_quorum)
+            & (stx.lead != 0)
+            & (stx.election_elapsed < cfg.election_timeout)
+        )
+        return (m.term > stx.term) & in_lease & ~(m.ctx == 1)
+
+    vmask = m.valid & is_vote & ~lease_block(st)
+    t_hi = jnp.max(jnp.where(vmask, m.term, -1))
+    st1 = _sel(
+        t_hi > st.term,
+        _become_follower(cfg, st, iid, slot, jnp.maximum(t_hi, st.term),
+                         jnp.zeros_like(st.lead)),
+        st,
+    )
+
+    eq = vmask & (m.term == st1.term)
+    last_term = term_at(
+        st1.log_term, st1.snap_index, st1.snap_term, st1.last, st1.last
+    )
+    up_to_date = (m.log_term > last_term) | (
+        (m.log_term == last_term) & (m.index >= st1.last)
+    )
+    can_vote = (st1.vote == senders + 1) | (
+        (st1.vote == 0) & (st1.lead == 0)
+    )
+    grantable = eq & can_vote & up_to_date & ~st1.fenced
+    has_grant = jnp.any(grantable)
+    granted = grantable & (senders == _argfirst(grantable))
+    st2 = st1._replace(
+        vote=jnp.where(has_grant, _argfirst(grantable) + 1, st1.vote),
+        election_elapsed=jnp.where(has_grant, 0, st1.election_elapsed),
+    )
+
+    # Prevote responses against the post-vote state (no state change:
+    # grants never record, ref: raft.go:960-972 m.Type == MsgPreVote).
+    pv = m.valid & is_pre & ~lease_block(st2)
+    lower_p = m.term < st2.term
+    # can_vote above read st1.vote; a grant recorded this lane changes
+    # it, so prevotes re-derive against st2.
+    can_pre = (st2.vote == senders + 1) | (
+        (st2.vote == 0) & (st2.lead == 0)
+    ) | (m.term > st2.term)
+    grant_p = pv & ~lower_p & can_pre & up_to_date & ~st2.fenced
+
+    resp = empty_msgs((r,), cfg.max_ents_per_msg)
+    resp = resp._replace(
+        valid=eq | pv,
+        type=jnp.where(is_vote, T_VOTE_RESP, T_PREVOTE_RESP),
+        term=jnp.where(grant_p, m.term,
+                       jnp.broadcast_to(st2.term, (r,))),
+        reject=jnp.where(is_vote, ~granted, ~grant_p),
+    )
+    return st2, resp
+
+
+def _vec_app_resp_effects(cfg: BatchedConfig, st: BatchedState,
+                          m: MsgSlots, eq):
+    """Columnwise _leader_app_resp for every same-term MsgAppResp at
+    once — sender s's message only ever touches progress column s, so
+    the R sequential handler applications collapse to masked column
+    updates plus ONE commit recompute and one bcast/resend fold. The
+    PR 4 wedge-repair semantics (stale-high match lowered to the
+    follower's own evidence) ride the same masks bit-for-bit.
+    `eq` gates to valid same-term T_APP_RESP on a leader."""
+    prog = _repl_targets(st)
+    ok = eq & prog
+    # recent_active is recorded for every handled message, progress row
+    # or not (the sequential handler sets it before the prog_ok gate).
+    st_in = st._replace(recent_active=st.recent_active | eq)
+
+    # --- rejected: move next back using the hint (raft.go:1130-1236) ---
+    hint = jax.vmap(
+        lambda idx, t: find_conflict_by_term(
+            st.log_term, st.snap_index, st.snap_term, st.last, idx, t)
+    )(m.reject_hint, m.log_term)
+    hint = jnp.where(m.log_term > 0, hint, m.reject_hint)
+    in_repl = st.pr_state == REPLICATE
+    stale_rej = jnp.where(
+        in_repl, m.index <= st.match, st.next - 1 != m.index
+    )
+    dec_next = jnp.where(
+        in_repl,
+        st.match + 1,
+        jnp.maximum(jnp.minimum(m.index, hint + 1), 1),
+    )
+    rej = ok & m.reject & ~stale_rej
+    # Stale-high match repair (the restarted-member progress wedge —
+    # see _leader_app_resp): lowering match is always safe.
+    match_repair = rej & (dec_next <= st.match)
+
+    # --- accepted: MaybeUpdate + state transitions ---
+    old_paused = _paused(cfg, st)
+    updated = st.match < m.index
+    accu = ok & ~m.reject & updated
+    new_match = jnp.maximum(st.match, m.index)
+    was_probe = st.pr_state == PROBE
+    was_snap = (st.pr_state == SNAPSHOT) & (
+        new_match >= st.pending_snapshot
+    )
+    to_repl = accu & (was_probe | was_snap)
+
+    match1 = jnp.where(match_repair, dec_next - 1, st.match)
+    match1 = jnp.where(accu, new_match, match1)
+    next1 = jnp.where(rej, dec_next, st.next)
+    next1 = jnp.where(accu, jnp.maximum(st.next, m.index + 1), next1)
+    next1 = jnp.where(to_repl, new_match + 1, next1)
+    pr1 = jnp.where(rej & in_repl, PROBE, st.pr_state)
+    pr1 = jnp.where(to_repl, REPLICATE, pr1)
+    st2 = st_in._replace(
+        match=match1,
+        next=next1,
+        pr_state=pr1,
+        probe_sent=st.probe_sent & ~rej & ~accu,
+        pending_snapshot=jnp.where(
+            (rej & in_repl) | to_repl, 0, st.pending_snapshot),
+        inflight=jnp.where((rej & in_repl) | accu, 0, st.inflight),
+        send_append=st.send_append | rej,
+    )
+    # ONE commit recompute: commit is monotone in match and the
+    # per-message recomputes' fixpoint equals the recompute on the
+    # final match plane (leader log terms above an own-term entry stay
+    # own-term, so the term gate cannot flip between prefix and final).
+    commit0 = st.commit
+    st2 = _maybe_commit(st2)
+    advanced = st2.commit > commit0
+    # bcastAppend on commit advance; per-column resend to previously
+    # paused peers / peers with entries remaining (raft.go:1259-1276).
+    resend = accu & (old_paused | (st2.last >= next1))
+    st2 = st2._replace(
+        send_append=jnp.where(
+            advanced,
+            st2.send_append | _repl_targets(st2),
+            st2.send_append | resend,
+        )
+    )
+    return _sel(jnp.any(eq), st2, st)
+
+
+def _vec_depose(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                m: MsgSlots):
+    """The response-lane depose tail: become follower at the highest
+    term carried by any deposing message, re-gated against the
+    post-effect state (a candidacy won this lane may have raised the
+    term past the depose)."""
+    keep = (m.type == T_PREVOTE_RESP) & ~m.reject
+    deposing = m.valid & (m.term > st.term) & ~keep
+    dep_t = jnp.max(jnp.where(deposing, m.term, -1))
+    return _sel(
+        dep_t > st.term,
+        _become_follower(cfg, st, iid, slot, jnp.maximum(dep_t, st.term),
+                         jnp.zeros_like(st.lead)),
+        st,
+    )
+
+
+def _vec_lane_vote_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                        m: MsgSlots):
+    """Lane KIND_VOTE_RESP, vectorized: record every same-term tally
+    vote at once (distinct senders → distinct slots; the sequential
+    early-exit on a decisive prefix equals the full tally, since
+    grants can only keep a won verdict and rejections a lost one),
+    then resolve won/lost once, then the depose tail."""
+    keep = (m.type == T_PREVOTE_RESP) & ~m.reject
+    is_cand = (st.role == CANDIDATE) | (st.role == PRECANDIDATE)
+    my_resp_type = jnp.where(
+        st.role == PRECANDIDATE, T_PREVOTE_RESP, T_VOTE_RESP
+    )
+    tally = (
+        m.valid
+        & ~(m.term < st.term)
+        & ~((m.term > st.term) & ~keep)
+        & (m.type == my_resp_type)
+        & is_cand
+    )
+    votes = jnp.where(
+        tally & (st.votes == -1), jnp.where(m.reject, 0, 1), st.votes
+    )
+    st_t = st._replace(votes=votes)
+    res = joint_vote_result(votes, st.voter, st.voter_out, st.in_joint)
+    won, lost = res == VOTE_WON, res == VOTE_LOST
+    if cfg.pre_vote:
+        st_won_pre = _campaign(cfg, st_t, iid, slot, False)
+    else:
+        st_won_pre = st_t
+    st_won_real = _become_leader(cfg, st_t, iid, slot)
+    peers_mask = _repl_targets(st_won_real) & (
+        jnp.arange(st.match.shape[-1], dtype=I32) != slot
+    )
+    st_won_real = st_won_real._replace(
+        send_append=st_won_real.send_append | peers_mask
+    )
+    st_won = _sel(st.role == PRECANDIDATE, st_won_pre, st_won_real)
+    st_lost = _become_follower(cfg, st_t, iid, slot, st_t.term,
+                               jnp.zeros_like(st.lead))
+    st_dec = _sel(won, st_won, _sel(lost, st_lost, st_t))
+    st1 = _sel(jnp.any(tally), st_dec, st)
+    return _vec_depose(cfg, iid, slot, st1, m)
+
+
+def _vec_lane_app_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                       m: MsgSlots):
+    """Lane KIND_APP_RESP, vectorized: the masked column fold above,
+    then the depose tail (a stale-leader nudge carrying a higher term
+    lands here — raft.go:885-905)."""
+    eq = (
+        m.valid & (m.term == st.term) & (m.type == T_APP_RESP)
+        & (st.role == LEADER)
+    )
+    st1 = _vec_app_resp_effects(cfg, st, m, eq)
+    return _vec_depose(cfg, iid, slot, st1, m)
+
+
+def _vec_lane_hb_resp(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                      m: MsgSlots):
+    """Lane KIND_HB_RESP, vectorized: heartbeat acks are a masked OR
+    into probe_sent/inflight/recent_active plus ONE ReadIndex quorum
+    recompute (acks are monotone; quorum on the full set equals the
+    sequential per-ack checks); T_APP_RESP stale-leader probes that
+    route back in this lane reuse the column fold; then the depose
+    tail."""
+    is_leader = st.role == LEADER
+    eqterm = m.valid & (m.term == st.term) & is_leader
+    prog = _repl_targets(st)
+    okh = eqterm & (m.type == T_HB_RESP) & prog
+    apr = eqterm & (m.type == T_APP_RESP)
+
+    full = st.inflight >= cfg.max_inflight
+    st_h = st._replace(
+        recent_active=st.recent_active | okh,
+        probe_sent=st.probe_sent & ~okh,
+        inflight=jnp.where(
+            okh & (st.pr_state == REPLICATE) & full,
+            jnp.maximum(st.inflight - 1, 0),
+            st.inflight,
+        ),
+        send_append=st.send_append | (okh & (st.match < st.last)),
+    )
+    # ReadIndex acks (read_only.go recvAck/advance). The sequential
+    # scans stop RECORDING once an ack confirms quorum mid-lane
+    # (pending drops with read_ready), so for bit-parity the fold
+    # records only the sender-ascending prefix up to and including the
+    # quorum-confirming ack: conf_at[s] = "quorum with acks from
+    # senders <= s folded in" is monotone in s, so the first set bit
+    # is where the sequential scan stopped. Bits past it are dead
+    # state either way (cleared at the next batch open) — this keeps
+    # the three shapes comparable field-for-field, not just
+    # protocol-equivalent.
+    senders = jnp.arange(st.match.shape[-1], dtype=I32)
+    pending = (st_h.read_index >= 0) & ~st_h.read_ready
+    inc = okh & pending & (m.ctx == st_h.read_seq) & (m.ctx > 0)
+    prefix = st_h.read_acks[None, :] | (
+        inc[None, :] & (senders[None, :] <= senders[:, None])
+    )  # [R prefixes, R]
+    conf_at = jax.vmap(
+        lambda a: joint_vote_result(
+            jnp.where(a, 1, -1), st_h.voter, st_h.voter_out,
+            st_h.in_joint) == VOTE_WON
+    )(prefix)
+    confirmed = jnp.any(conf_at)  # == quorum over the full fold
+    rec = inc & (~confirmed | (senders <= _argfirst(conf_at)))
+    st_h = st_h._replace(
+        read_acks=st_h.read_acks | rec,
+        read_ready=st_h.read_ready
+        | (pending & confirmed & jnp.any(okh)),
+    )
+    st_a = _vec_app_resp_effects(cfg, st_h, m, apr)
+    return _vec_depose(cfg, iid, slot, st_a, m)
+
+
+def _deliver_vectorized(cfg: BatchedConfig, iid, slot, st: BatchedState,
+                        inbox: MsgSlots, lane_any=None):
+    """Scan-free deliver: lanes in kind order, each lane one vectorized
+    fold over the sender axis (see the order contract in the section
+    comment above). With no lax.scan barrier left anywhere in the
+    round, deliver→tick→control→propose→emit trace into ONE
+    straight-line fused region — the full-state loop-carry round trips
+    of the scanned shapes disappear, and the named_scope annotations
+    (ROUND_PHASE_SCOPES) survive purely as attribution labels inside
+    the fused program. Each lane runs under lax.cond on its occupancy
+    (see _deliver_all on ``lane_any``), so idle lanes are skipped for
+    the whole batch."""
+    lane = lambda k: jax.tree.map(lambda x, _k=k: x[:, _k], inbox)  # noqa: E731
+    no_resp = empty_msgs((cfg.num_replicas,), cfg.max_ents_per_msg)
+
+    def occupied(k, m):
+        if lane_any is None:
+            return jnp.any(m.valid)
+        return lane_any[k]
+
+    def with_resp(k, fn, stx):
+        m = lane(k)
+        return jax.lax.cond(
+            occupied(k, m),
+            lambda sty, mx: fn(sty, mx),
+            lambda sty, mx: (sty, no_resp),
+            stx, m,
+        )
+
+    def state_only(k, fn, stx):
+        m = lane(k)
+        return jax.lax.cond(
+            occupied(k, m),
+            lambda sty, mx: fn(sty, mx),
+            lambda sty, mx: sty,
+            stx, m,
+        )
+
+    st, r0 = with_resp(
+        KIND_VOTE, lambda s, m: _vec_lane_vote(cfg, iid, slot, s, m),
+        st)
+    st, r1 = with_resp(
+        KIND_APP,
+        lambda s, m: _vec_lane_request(
+            cfg, iid, slot, s, m, _lane_app, hb_lane=False), st)
+    st, r2 = with_resp(
+        KIND_HB,
+        lambda s, m: _vec_lane_request(
+            cfg, iid, slot, s, m, _lane_hb, hb_lane=True), st)
+    st = state_only(
+        KIND_VOTE_RESP,
+        lambda s, m: _vec_lane_vote_resp(cfg, iid, slot, s, m), st)
+    st = state_only(
+        KIND_APP_RESP,
+        lambda s, m: _vec_lane_app_resp(cfg, iid, slot, s, m), st)
+    st = state_only(
+        KIND_HB_RESP,
+        lambda s, m: _vec_lane_hb_resp(cfg, iid, slot, s, m), st)
     # [R] per request lane → [R, 3].
     req = jax.tree.map(
         lambda a, b, c: jnp.stack((a, b, c), axis=1), r0, r1, r2
@@ -1174,10 +1684,12 @@ def route(cfg: BatchedConfig, outbox: MsgSlots) -> MsgSlots:
 
     with jax.named_scope("raft_route"):
         inbox = jax.tree.map(tr, outbox)
-    # Requests (kinds 0..2) arrive as-is; responses were produced into
-    # kinds 0..2 of the responder's outbox rows and must land in kinds
-    # 3..5 of the requester's inbox. The emit/deliver split already wrote
-    # them to separate kind lanes, so nothing further to do here.
+    # Lane indexes pass through untouched: by the inbox lane-order
+    # contract (NUM_REQ_KINDS, top of module), emit writes requests
+    # into lanes 0..NUM_REQ_KINDS-1 and the round's response scatter
+    # has ALREADY placed each response in lane k + NUM_REQ_KINDS of the
+    # responder's outbox row for the requester (see _step_round_jit),
+    # so the transpose alone lands everything in its inbox lane.
     return inbox
 
 
@@ -1330,26 +1842,50 @@ class StepAux(NamedTuple):
 
 
 @functools.lru_cache(maxsize=None)
-def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
+def _step_round_jit(cfg: BatchedConfig, with_aux: bool,
+                    lane_skip: bool = True):
     """One jitted round program per config — shared by every engine/
     node with the same config, whatever rows it hosts (iids/slots are
     runtime arguments, so three hosting processes' nodes reuse one
-    compilation per shape)."""
+    compilation per shape).
+
+    ``lane_skip`` enables the vectorized shape's batch-level lane-
+    occupancy conds. It MUST be off for mesh-sharded callers: the
+    occupancy reduce (any over the sharded instance axis) would be the
+    round's first cross-device collective — the sharded layout's whole
+    point is that NO collective rides the hot path (row-local quorums,
+    ROADMAP item 3), and concurrent per-member sharded programs
+    deadlock in the AllReduce rendezvous. Without it the conds take
+    per-instance predicates and batch away into selects — correct,
+    merely unskipped."""
     # Recompile sentinel: one key per distinct round-step program this
     # session (the lru_cache means this runs once per config). The
     # tier-1 shape budget in tests/batched/conftest.py audits this set.
-    note_compile_key("round_step", f"{cfg}|aux={int(with_aux)}")
+    note_compile_key(
+        "round_step",
+        f"{cfg}|aux={int(with_aux)}|laneskip={int(lane_skip)}")
 
     def step_round(st: BatchedState, inbox: MsgSlots, tick_mask, campaign_mask,
                    propose_n, isolate, transfer_to, read_req, iids, slots):
         if cfg.narrow_lanes:
             # Narrow lanes live int8/int16 BETWEEN rounds (the donated
-            # state carry); the protocol math runs on i32 exactly as in
-            # the wide layout, so parity is by construction.
+            # state carry AND the routed inbox); the protocol math runs
+            # on i32 exactly as in the wide layout, so parity is by
+            # construction.
             st = widen_state(st)
+            inbox = widen_msgs(inbox)
+
+        # Batch-level lane occupancy for the vectorized shape's
+        # lax.cond lane skips: computed OUTSIDE the vmap and passed
+        # unmapped (in_axes=None), so the conds stay real branches
+        # instead of degrading to selects under a mapped predicate.
+        # None when lane_skip is off (sharded callers — see docstring).
+        lane_any = (
+            jnp.any(inbox.valid, axis=(0, 1)) if lane_skip else None
+        )  # [K]
 
         def per_instance(iid, slot, sti, inbox_i, do_tick, do_camp, n_new,
-                         iso, tr_to, rd_req):
+                         iso, tr_to, rd_req, lane_any):
             # Partitioned instances neither receive nor send this round
             # (fault injection; ref: tests/framework bridge & pkg/proxy).
             # Phases carry jax.named_scope annotations so xprof/JAX
@@ -1358,7 +1894,8 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
             pre = sti  # round-entry state (telemetry deltas)
             inbox_i = inbox_i._replace(valid=inbox_i.valid & ~iso)
             with jax.named_scope("raft_deliver"):
-                sti, req_resps = _deliver_all(cfg, iid, slot, sti, inbox_i)
+                sti, req_resps = _deliver_all(cfg, iid, slot, sti, inbox_i,
+                                              lane_any)
             with jax.named_scope("raft_tick"):
                 sti = _tick(cfg, iid, slot, sti, do_tick, do_camp)
             read_snap = (sti.read_seq, sti.read_index, sti.read_ready)
@@ -1369,10 +1906,12 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
                 sti = _propose(cfg, slot, sti, n_new)
             with jax.named_scope("raft_emit"):
                 sti, out = _emit(cfg, slot, sti)
-            # Responses to requests from sender s (kinds 0..2) land in
-            # out[s, 3+k]; they route back by the same transpose.
+            # Responses to requests from sender s (request kinds) land
+            # in out[s, k + NUM_REQ_KINDS]; they route back by the same
+            # transpose (the inbox lane-order contract, top of module).
             out = jax.tree.map(
-                lambda o, rr: o.at[:, 3:].set(rr), out, req_resps
+                lambda o, rr: o.at[:, NUM_REQ_KINDS:].set(rr),
+                out, req_resps,
             )
             out = out._replace(valid=out.valid & ~iso)
             ret = (sti, out, StepAux(last_tick, *read_snap))
@@ -1398,13 +1937,16 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
                  propose_n, isolate, transfer_to, read_req),
             )
             outs = jax.vmap(
-                per_instance, in_axes=-1, out_axes=-1
-            )(*args)
+                per_instance,
+                in_axes=(-1,) * len(args) + (None,), out_axes=-1,
+            )(*args, lane_any)
             outs = jax.tree.map(to_major, outs)
         else:
-            outs = jax.vmap(per_instance)(
+            outs = jax.vmap(
+                per_instance, in_axes=(0,) * 10 + (None,),
+            )(
                 iids, slots, st, inbox, tick_mask, campaign_mask,
-                propose_n, isolate, transfer_to, read_req,
+                propose_n, isolate, transfer_to, read_req, lane_any,
             )
         sti, out, aux = outs[:3]
         fleet = None
@@ -1416,6 +1958,9 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
                 fleet = _fleet_frame(cfg, st, sti, iids, slots)
         if cfg.narrow_lanes:
             sti = narrow_state(sti)
+            # Telemetry/fleet frames above read the WIDE outbox; the
+            # narrowed one is what rides the route()→inbox carry.
+            out = narrow_msgs(out)
         # Output order: (state, outbox[, aux][, telemetry][, fleet]) —
         # callers index via the cfg flags (engine/rawnode compute the
         # positions once at build time).
@@ -1437,7 +1982,7 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool):
 
 
 def make_step_round(cfg: BatchedConfig, iids=None, slots=None,
-                    with_aux: bool = False):
+                    with_aux: bool = False, lane_skip: bool = True):
     """Build the round function:
 
         state, outbox[, aux] = step_round(state, inbox, tick_mask,
@@ -1448,6 +1993,12 @@ def make_step_round(cfg: BatchedConfig, iids=None, slots=None,
     explicit `iids`/`slots` for a hosting process that owns one replica
     slot of each group (iid = group*R + slot keeps the deterministic
     randomized-timeout hash identical across topologies)."""
+    # Resolve deliver_shape="auto" BEFORE the per-config jit cache so
+    # "auto" and its concrete platform resolution share one program.
+    # ``lane_skip=False`` is for mesh-sharded callers — see
+    # _step_round_jit on why the occupancy reduce must not cross
+    # shards.
+    cfg = cfg.resolved()
     if iids is None:
         iids = jnp.arange(cfg.num_instances, dtype=I32)
     else:
@@ -1456,7 +2007,7 @@ def make_step_round(cfg: BatchedConfig, iids=None, slots=None,
         slots = iids % cfg.num_replicas
     else:
         slots = jnp.asarray(slots, I32)
-    inner = _step_round_jit(cfg, with_aux)
+    inner = _step_round_jit(cfg, with_aux, lane_skip)
     n = iids.shape[0]
     zero_i = jnp.zeros((n,), I32)
     zero_b = jnp.zeros((n,), bool)
@@ -1493,6 +2044,11 @@ def _pack_outbox_jit():
 
     def pack(valid, typ, reject, n_ents, term, log_term, index, commit,
              reject_hint, ctx, slots):
+        # The outbox may arrive in narrow storage dtypes
+        # (cfg.narrow_lanes → NARROW_MSG_DTYPES); the shift/or packing
+        # below needs i32 words (an int8 `typ << 24` would wrap).
+        typ = typ.astype(I32)
+        n_ents = n_ents.astype(I32)
         n, r, _k = typ.shape
         shape = typ.shape
         rows = jnp.broadcast_to(

@@ -61,23 +61,23 @@ def _log(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
-def _make_engine(groups: int, merged: bool, telemetry: bool = False):
+def _make_engine(groups: int, shape: str, telemetry: bool = False):
     # The bench.py config and setup (BENCH_r05 methodology), from the
     # shared module so the sweep cannot desynchronize from bench.py.
     from .benchlib import make_bench_engine
 
     return make_bench_engine(groups, lanes_minor=True,
-                             merged_deliver=merged,
+                             deliver_shape=shape,
                              telemetry=telemetry)
 
 
-def _pipeline_gate(merged: bool) -> None:
+def _pipeline_gate(shape: str) -> None:
     """Refuse to measure a pipelined loop that diverges from
     single-round stepping (the shadow-verified path)."""
     import numpy as np
 
-    a, props = _make_engine(64, merged)
-    b, _ = _make_engine(64, merged)
+    a, props = _make_engine(64, shape)
+    b, _ = _make_engine(64, shape)
     a.run_rounds_pipelined(48, chunk=8, tick=True, propose_n=props)
     for _ in range(48):
         b.step_round(tick=True, propose_n=props)
@@ -88,16 +88,16 @@ def _pipeline_gate(merged: bool) -> None:
         assert (av == bv).all(), (
             f"pipelined loop diverged from single-round stepping on "
             f"{f}; refusing to record frontier numbers")
-    _log("pipeline gate: pipelined == single-round stepping over "
-         "48 rounds at G=64")
+    _log(f"pipeline gate[{shape}]: pipelined == single-round "
+         "stepping over 48 rounds at G=64")
 
 
-def _measure_point(groups: int, merged: bool, rounds_per_call: int,
+def _measure_point(groups: int, shape: str, rounds_per_call: int,
                    calls: int, telemetry: bool = False) -> dict:
     from .benchlib import measure_commit_p50, measure_rate
 
     t0 = time.perf_counter()
-    eng, props = _make_engine(groups, merged, telemetry)
+    eng, props = _make_engine(groups, shape, telemetry)
     build_s = time.perf_counter() - t0
     _log(f"G={groups}: built+compiled in {build_s:.1f}s")
 
@@ -116,6 +116,7 @@ def _measure_point(groups: int, merged: bool, rounds_per_call: int,
     gc.collect()
     return {
         "groups": groups,
+        "deliver": shape,
         "rate_group_rounds_per_s": round(rate, 1),
         "commit_p50_ms": round(p50_ms, 2),
         "commit_p50_rounds": rounds,
@@ -123,20 +124,18 @@ def _measure_point(groups: int, merged: bool, rounds_per_call: int,
     }
 
 
-def _warm_probe(groups: int, merged: bool) -> None:
+def _warm_probe(groups: int, shape: str) -> None:
     """Subprocess mode: build one engine and print its build time —
     a fresh process has no in-memory jit cache, so this measures the
     persistent-cache warm start."""
     t0 = time.perf_counter()
-    _make_engine(groups, merged)
+    _make_engine(groups, shape)
     print(json.dumps({"build_s": round(time.perf_counter() - t0, 2)}))
 
 
-def _run_warm_probe(groups: int, merged: bool) -> "float | None":
+def _run_warm_probe(groups: int, shape: str) -> "float | None":
     cmd = [sys.executable, "-m", "etcd_tpu.tools.frontier_sweep",
-           "--warm-probe", str(groups)]
-    if merged:
-        cmd.append("--merged")
+           "--warm-probe", str(groups), "--deliver-shape", shape]
     try:
         out = subprocess.run(cmd, capture_output=True, timeout=1800,
                              check=True)
@@ -149,12 +148,13 @@ def _run_warm_probe(groups: int, merged: bool) -> "float | None":
 
 def _markdown(result: dict) -> str:
     lines = [
-        "| G | group-rounds/s | commit p50 (ms) | rounds | build (s) |",
-        "|---|---|---|---|---|",
+        "| G | deliver | group-rounds/s | commit p50 (ms) | rounds "
+        "| build (s) |",
+        "|---|---|---|---|---|---|",
     ]
     for p in result["points"]:
         lines.append(
-            "| {groups} | {rate_group_rounds_per_s:,.0f} | "
+            "| {groups} | {deliver} | {rate_group_rounds_per_s:,.0f} | "
             "{commit_p50_ms} | {commit_p50_rounds} | {build_s} |"
             .format(**p))
     ws = result.get("warm_start")
@@ -173,8 +173,12 @@ def main() -> None:
     ap.add_argument("--out", default="artifacts/frontier.json")
     ap.add_argument("--rounds-per-call", type=int, default=16)
     ap.add_argument("--calls", type=int, default=8)
-    ap.add_argument("--merged", action="store_true",
-                    help="merged request/response deliver scans")
+    ap.add_argument("--deliver-shape", default="",
+                    help="comma-separated deliver shapes to sweep "
+                         "(lanes|merged|vectorized; default: the "
+                         "platform default shape). Each point row "
+                         "records its shape, so one sweep writes the "
+                         "per-shape frontier (ISSUE 14).")
     ap.add_argument("--telemetry", action="store_true",
                     help="compile the kernel telemetry plane into the "
                          "measured round (overhead sweep; ISSUE 4)")
@@ -191,7 +195,7 @@ def main() -> None:
     cache_dir = enable_compile_cache()
 
     if args.warm_probe:
-        _warm_probe(args.warm_probe, args.merged)
+        _warm_probe(args.warm_probe, args.deliver_shape or "auto")
         return
 
     _log(f"compile cache: {cache_dir or 'disabled'}")
@@ -200,23 +204,35 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     accelerated = platform in ("tpu", "axon")
-    merged = args.merged or accelerated
+    from etcd_tpu.batched.state import DELIVER_SHAPES, \
+        default_deliver_shape
+
+    if args.deliver_shape:
+        shapes = [s.strip() for s in args.deliver_shape.split(",")]
+        for s in shapes:
+            if s not in DELIVER_SHAPES:
+                raise SystemExit(
+                    f"unknown deliver shape {s!r} (choose from "
+                    f"{DELIVER_SHAPES})")
+    else:
+        shapes = [default_deliver_shape()]
     if args.groups:
         group_list = [int(g) for g in args.groups.split(",")]
     else:
         group_list = TPU_GROUPS if accelerated else CPU_GROUPS
     _log(f"platform={platform} sweep G={group_list} "
-         f"deliver={'merged' if merged else 'six'}")
+         f"deliver={','.join(shapes)}")
 
     if not args.skip_gate:
-        _pipeline_gate(merged)
+        for s in shapes:
+            _pipeline_gate(s)
 
     result: dict = {
         "platform": platform,
         "device": str(jax.devices()[0]),
         "loop": "pipelined (run_rounds_pipelined chunk=%d depth=2)"
                 % args.rounds_per_call,
-        "deliver": "merged" if merged else "six",
+        "deliver": shapes,
         "telemetry": bool(args.telemetry),
         "compile_cache": cache_dir or "disabled",
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -231,19 +247,20 @@ def main() -> None:
             f.write("\n")
 
     for g in group_list:
-        try:
-            result["points"].append(
-                _measure_point(g, merged, args.rounds_per_call,
-                               args.calls, args.telemetry))
-        except Exception as e:  # noqa: BLE001 — record partial frontier
-            _log(f"G={g} failed: {e!r}; frontier stays partial")
-            result.setdefault("failed", []).append(
-                {"groups": g, "error": repr(e)})
-        flush()
+        for s in shapes:
+            try:
+                result["points"].append(
+                    _measure_point(g, s, args.rounds_per_call,
+                                   args.calls, args.telemetry))
+            except Exception as e:  # noqa: BLE001 — partial frontier
+                _log(f"G={g} {s} failed: {e!r}; frontier stays partial")
+                result.setdefault("failed", []).append(
+                    {"groups": g, "deliver": s, "error": repr(e)})
+            flush()
 
     if not args.skip_warm_check and result["points"] and cache_dir:
         p0 = result["points"][0]
-        warm = _run_warm_probe(p0["groups"], merged)
+        warm = _run_warm_probe(p0["groups"], p0["deliver"])
         result["warm_start"] = {
             "groups": p0["groups"],
             "cold_build_s": p0["build_s"],

@@ -5,8 +5,10 @@ is never wasted on a single capture (the round-4 lesson):
 
   (a) re-capture the G=65536 headline rate (six-lane deliver, the
       bench.py default config) so the driver record can be confirmed;
-  (b) six-vs-two merged deliver scans ON TPU (CPU favored six 2x;
-      CPU has not predicted TPU for this kernel before);
+  (b) deliver-shape A/B ON TPU — merged scans and the ISSUE 14
+      vectorized fold vs the six-lane baseline (--deliver-shape; CPU
+      has not predicted TPU for this kernel before, so the accelerator
+      default only ever moves on numbers from this section);
   (c) the Pallas fused quorum/ring kernels vs their XLA forms
       (integration gate, pallas_kernels.py docstring);
   (d) device-side commit p50 — rounds-to-commit counted by stepping
@@ -36,7 +38,7 @@ def _log(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
-def _make_engine(groups: int, merged: bool):
+def _make_engine(groups: int, shape: str):
     import jax.numpy as jnp
 
     from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
@@ -51,7 +53,7 @@ def _make_engine(groups: int, merged: bool):
         heartbeat_timeout=4,
         auto_compact=True,
         lanes_minor=True,  # pinned lane-filling layout (bench.py on TPU)
-        merged_deliver=merged,
+        deliver_shape=shape,
     )
     eng = MultiRaftEngine(cfg)
     eng.campaign([g * cfg.num_replicas for g in range(groups)])
@@ -79,7 +81,13 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--groups", type=int, default=65536)
     ap.add_argument("--out", default="artifacts/tpu_r05")
+    ap.add_argument("--deliver-shape", dest="deliver_shapes",
+                    default="merged,vectorized",
+                    help="comma-separated deliver shapes to A/B "
+                         "against the six-lane baseline (section b)")
     args = ap.parse_args()
+    args.deliver_shapes = [s.strip() for s in
+                           args.deliver_shapes.split(",") if s.strip()]
 
     import jax
     import jax.numpy as jnp
@@ -109,7 +117,7 @@ def main() -> None:
 
     # ---- (a) headline capture: six-lane deliver, bench.py config ----
     t0 = time.perf_counter()
-    eng, props = _make_engine(args.groups, merged=False)
+    eng, props = _make_engine(args.groups, "lanes")
     compile_s = time.perf_counter() - t0
     _log(f"(a) six-lane G={args.groups} built+compiled in {compile_s:.0f}s")
     rate_six = _rate(eng, props)
@@ -119,7 +127,7 @@ def main() -> None:
     result["a_six_lane"] = {
         "rate_group_rounds_per_s": round(rate_six, 1),
         "compile_s": round(compile_s, 1),
-        "config": "G=%d R=3 W=32 layout=minor merged_deliver=False"
+        "config": "G=%d R=3 W=32 layout=minor deliver=lanes"
                   % args.groups,
         "commits_min": int(commits.min()),
     }
@@ -195,27 +203,32 @@ def main() -> None:
         _log(f"(c) pallas_bench failed: {e!r}")
     flush()
 
-    # ---- (b) merged two-scan deliver shape ----
-    try:
-        t0 = time.perf_counter()
-        eng2, props2 = _make_engine(args.groups, merged=True)
-        compile2_s = time.perf_counter() - t0
-        _log(f"(b) merged G={args.groups} built+compiled in "
-             f"{compile2_s:.0f}s")
-        rate_merged = _rate(eng2, props2)
-        assert eng2.commits().min() > 0
-        _log(f"(b) merged rate: {rate_merged:,.0f} group-rounds/s "
-             f"({rate_merged / rate_six:.2f}x six-lane)")
-        result["b_merged_deliver"] = {
-            "rate_group_rounds_per_s": round(rate_merged, 1),
-            "compile_s": round(compile2_s, 1),
-            "vs_six_lane": round(rate_merged / rate_six, 3),
-        }
-        del eng2, props2
-    except Exception as e:  # noqa: BLE001
-        result["b_merged_deliver"] = {"ok": False, "error": repr(e)}
-        _log(f"(b) merged deliver failed: {e!r}")
-    flush()
+    # ---- (b) deliver-shape A/B ON TPU (--deliver-shape picks the
+    # comparison set; default covers merged + the ISSUE 14 vectorized
+    # fold — the on-device tuning the r5 notes demanded, one command
+    # when the tunnel is live) ----
+    for shape in args.deliver_shapes:
+        key = f"b_deliver_{shape}"
+        try:
+            t0 = time.perf_counter()
+            eng2, props2 = _make_engine(args.groups, shape)
+            compile2_s = time.perf_counter() - t0
+            _log(f"(b) {shape} G={args.groups} built+compiled in "
+                 f"{compile2_s:.0f}s")
+            rate_shape = _rate(eng2, props2)
+            assert eng2.commits().min() > 0
+            _log(f"(b) {shape} rate: {rate_shape:,.0f} group-rounds/s "
+                 f"({rate_shape / rate_six:.2f}x six-lane)")
+            result[key] = {
+                "rate_group_rounds_per_s": round(rate_shape, 1),
+                "compile_s": round(compile2_s, 1),
+                "vs_six_lane": round(rate_shape / rate_six, 3),
+            }
+            del eng2, props2
+        except Exception as e:  # noqa: BLE001
+            result[key] = {"ok": False, "error": repr(e)}
+            _log(f"(b) {shape} deliver failed: {e!r}")
+        flush()
 
     _log("batch complete")
     print(json.dumps(result))

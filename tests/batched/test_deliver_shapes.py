@@ -1,0 +1,280 @@
+"""ISSUE 14: deliver-shape equivalence (lanes | merged | vectorized).
+
+The vectorized deliver replaces the sequential sender scans with
+masked reductions and winner tournaments (step.py _deliver_vectorized).
+Its order contract is pinned against the shadow oracle by
+test_differential.py (parametrized over all three shapes); THIS module
+pins the three shapes against EACH OTHER on seeded adversarial
+workloads — contested elections, torn-tail rejection/repair, ReadIndex
+confirmation — where the protocol outcome must be bit-identical
+because every delivery-order difference the shapes are allowed to have
+(deposes commuting with same-term effects) is unreachable without
+pre-vote piggybacking, and these configs run pre_vote=False.
+
+Engine configs intentionally reuse test_differential.py's values
+(G=2/R=3/W=64/E=16/P=4, ET=1<<20, unbounded inflight) so the three
+round-step programs here are the SAME three the lockstep suite
+compiles — zero new entries against ROUND_STEP_SHAPE_BUDGET.
+
+The slow-marked chaos cells at the bottom re-fly a quick-chaos episode
+under the non-default shapes (the CPU default already covers
+vectorized in test_chaos.py), so every SHIPPED deliver shape closes
+the strict checkers with ``invariant_trips() == 0``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from etcd_tpu.batched import BatchedConfig, MultiRaftEngine
+
+R = 3
+ET = 1 << 20
+SHAPES = ("lanes", "merged", "vectorized")
+
+# Every protocol-visible field of BatchedState (send flags included:
+# the shapes must agree on what the NEXT round will emit, not just on
+# the HardState face).
+STATE_FIELDS = (
+    "term", "vote", "role", "lead", "log_term", "snap_index",
+    "snap_term", "last", "commit", "applied", "match", "next",
+    "pr_state", "probe_sent", "pending_snapshot", "recent_active",
+    "inflight", "votes", "read_seq", "read_index", "read_acks",
+    "read_ready", "read_req_latch", "send_append", "send_heartbeat",
+    "send_vote_req", "transferee", "transfer_sent",
+)
+
+
+def make_engine(shape, groups=2):
+    cfg = BatchedConfig(
+        num_groups=groups,
+        num_replicas=R,
+        window=64,
+        max_ents_per_msg=16,
+        max_props_per_round=4,
+        election_timeout=ET,
+        heartbeat_timeout=1,
+        max_inflight=1 << 20,
+        deliver_shape=shape,
+    )
+    return MultiRaftEngine(cfg)
+
+
+def assert_states_equal(engines, rnd, context):
+    ref_shape, ref = engines[0]
+    for shape, eng in engines[1:]:
+        for f in STATE_FIELDS:
+            a = np.asarray(getattr(ref.state, f))
+            b = np.asarray(getattr(eng.state, f))
+            assert (a == b).all(), (
+                f"{context} round {rnd}: {shape} diverges from "
+                f"{ref_shape} on {f}:\n{a}\nvs\n{b}")
+
+
+def run_schedule(schedule, context):
+    """Drive identical schedules through one engine per shape and
+    compare EVERY protocol state field after every round."""
+    engines = [(s, make_engine(s)) for s in SHAPES]
+    n = engines[0][1].cfg.num_instances
+    for rnd, step in enumerate(schedule):
+        camp = np.zeros(n, bool)
+        props = np.zeros(n, np.int32)
+        iso = np.zeros(n, bool)
+        for g, s in step.get("campaign", []):
+            camp[g * R + s] = True
+        for (g, s), k in step.get("propose", {}).items():
+            props[g * R + s] = k
+        for g, s in step.get("isolate", []):
+            iso[g * R + s] = True
+        read = np.zeros(n, bool)
+        for g, s in step.get("read", []):
+            read[g * R + s] = True
+        for _shape, eng in engines:
+            eng.step_round(
+                tick=step.get("tick", False),
+                campaign_mask=jnp.asarray(camp),
+                propose_n=jnp.asarray(props),
+                isolate=jnp.asarray(iso),
+                read_req=jnp.asarray(read),
+            )
+        assert_states_equal(engines, rnd, context)
+    return engines
+
+
+def test_contested_elections_agree():
+    """All three replicas campaign in the same round (guaranteed split
+    vote), then staggered re-campaigns contest the follow-up term —
+    the vote-lane tournament and the tally reductions must reproduce
+    the scan shapes' grants/rejections exactly."""
+    schedule = (
+        [{"campaign": [(0, 0), (0, 1), (0, 2), (1, 0), (1, 1), (1, 2)]}]
+        + [{} for _ in range(3)]
+        # Two-way contest at the next term; sender-order tie-breaks.
+        + [{"campaign": [(0, 1), (0, 2), (1, 0), (1, 2)]}]
+        + [{} for _ in range(4)]
+        # A clean winner, then load.
+        + [{"campaign": [(0, 0), (1, 2)]}]
+        + [{} for _ in range(4)]
+        + [{"propose": {(0, 0): 3, (1, 2): 2}}]
+        + [{} for _ in range(4)]
+    )
+    engines = run_schedule(schedule, "contested elections")
+    # The last campaign round must actually have elected leaders.
+    for _shape, eng in engines:
+        assert (eng.leaders() >= 0).all()
+
+
+def test_torn_tail_rejection_repair_agree():
+    """Partitioned leader appends a divergent tail; the new leader's
+    probe is rejected with a hint and the tail truncated on heal — the
+    reject/repair column fold (incl. the PR 4 stale-high match repair
+    masks) must match the scan shapes bit-for-bit."""
+    iso = [(0, 0)]
+    schedule = (
+        [{"campaign": [(0, 0)]}]
+        + [{} for _ in range(4)]
+        + [{"propose": {(0, 0): 2}}]
+        + [{} for _ in range(3)]
+        + [{"isolate": iso, "propose": {(0, 0): 3}}]
+        + [{"isolate": iso} for _ in range(2)]
+        + [{"isolate": iso, "campaign": [(0, 1)]}]
+        + [{"isolate": iso} for _ in range(4)]
+        + [{"isolate": iso, "propose": {(0, 1): 2}}]
+        + [{"isolate": iso} for _ in range(4)]
+        + [{"tick": True}]
+        + [{} for _ in range(6)]
+    )
+    engines = run_schedule(schedule, "torn-tail repair")
+    for _shape, eng in engines:
+        c = eng.commits()
+        assert (c[0] == c[0][0]).all() and c[0][0] >= 4
+
+
+def test_readindex_confirmation_agrees():
+    """ReadIndex batches confirm via ctx-echoing heartbeat acks — the
+    hb-resp lane's single quorum recompute must confirm on exactly the
+    same round as the sequential per-ack checks."""
+    schedule = (
+        [{"campaign": [(0, 0), (1, 1)]}]
+        + [{} for _ in range(4)]
+        + [{"propose": {(0, 0): 2, (1, 1): 1}}]
+        + [{} for _ in range(3)]
+        + [{"read": [(0, 0), (1, 1)]}]
+        + [{} for _ in range(4)]
+        # Re-open a second batch while acks for nothing are pending.
+        + [{"read": [(0, 0)]}]
+        + [{} for _ in range(4)]
+    )
+    engines = run_schedule(schedule, "readindex")
+    for _shape, eng in engines:
+        seq, idx, ready = eng.read_states()
+        assert ready[0] and idx[0] >= 0
+        assert seq[0] == 2 and seq[R + 1] == 1
+
+
+def test_vectorized_pipelined_matches_serial():
+    """The pipelined closed loop (donated buffers, chunked scans) over
+    the vectorized round must equal serial single-round stepping —
+    the frontier-sweep gate, pinned as a test for the new shape."""
+    a = make_engine("vectorized")
+    b = make_engine("vectorized")
+    n = a.cfg.num_instances
+    camp = np.zeros(n, bool)
+    camp[[0, R]] = True
+    for eng in (a, b):
+        eng.step_round(campaign_mask=jnp.asarray(camp))
+    props = jnp.zeros((n,), jnp.int32).at[jnp.asarray([0, R])].set(2)
+    a.run_rounds_pipelined(24, chunk=6, tick=True, propose_n=props)
+    for _ in range(24):
+        b.step_round(tick=True, propose_n=props)
+    assert_states_equal([("serial", b), ("pipelined", a)], 24,
+                        "pipelined vs serial")
+    assert a.commits().min() > 0
+
+
+def test_hosted_narrow_message_staging():
+    """cfg.narrow_lanes now covers the message path (ISSUE 14
+    satellite): the hosted staging buffers build int8 wire types /
+    int16 entry counts (rawnode._build_inbox), the kernel widens at
+    deliver entry, and pack_outbox widens before shifting bytes. A
+    three-member hosted exchange (campaign → replicate → commit)
+    proves the dtype contract end to end."""
+    from etcd_tpu.batched.rawnode import BatchedRawNode
+
+    g = 4
+    cfg = BatchedConfig(
+        num_groups=g, num_replicas=R, window=16, max_ents_per_msg=4,
+        max_props_per_round=2, election_timeout=1 << 20,
+        heartbeat_timeout=1, narrow_lanes=True,
+        deliver_shape="vectorized",
+    )
+    rns = {
+        mid: BatchedRawNode(
+            cfg,
+            groups=np.arange(g, dtype=np.int32),
+            slots=np.full(g, mid - 1, np.int32),
+        )
+        for mid in (1, 2, 3)
+    }
+    with rns[1]._lock:
+        inbox = rns[1]._build_inbox()
+    assert np.asarray(inbox.type).dtype == np.int8
+    assert np.asarray(inbox.n_ents).dtype == np.int16
+    assert np.asarray(inbox.term).dtype == np.int32
+
+    def pump(rounds):
+        for _ in range(rounds):
+            for mid, rn in rns.items():
+                rd = rn.advance_round()
+                blk = rd.msg_block
+                if blk is not None and len(blk):
+                    for to, sub in sorted(
+                            blk.split_by_target().items()):
+                        rns[to].step_block(sub)
+                for row, m in rd.messages:
+                    rns[m.to].step(row, m)
+                rn.advance()
+
+    rns[1].campaign(list(range(g)))
+    pump(4)
+    for row in range(g):
+        rns[1].propose(row, b"narrow-%d" % row)
+    pump(6)
+    commits = np.asarray(rns[1].state.commit)
+    assert (commits >= 2).all(), commits
+    # Round-tripped state keeps the narrow storage dtypes.
+    assert np.asarray(rns[1].state.role).dtype == np.int8
+
+
+# -- chaos re-fly for the non-default shapes (slow: the CPU-default
+# vectorized shape already runs the whole quick subset in
+# test_chaos.py) --------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("shape", ["lanes", "merged"])
+def test_chaos_msg_faults_other_shapes(tmp_path, shape):
+    """One message-fault episode per non-default shape, strict
+    3-checker + invariant_trips() == 0 (the quick-chaos bar)."""
+    from etcd_tpu.batched.faults import (
+        ChaosHarness,
+        FaultSpec,
+        LeaderObserver,
+        run_invariant_checks,
+    )
+    from .test_chaos import CFG, MSG_FAULTS, SEEDS
+
+    cfg = CFG._replace(deliver_shape=shape)
+    h = ChaosHarness(str(tmp_path), SEEDS[0], MSG_FAULTS,
+                     num_members=R, num_groups=cfg.num_groups, cfg=cfg)
+    obs = LeaderObserver(h.alive)
+    try:
+        h.wait_leaders()
+        obs.start()
+        acked = h.run_workload(20)
+        assert acked >= 10, f"only {acked}/20 writes acked"
+        h.plan.quiesce()
+        run_invariant_checks(h, obs, expect_members=R)
+    finally:
+        obs.stop()
+        h.stop()

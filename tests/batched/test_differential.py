@@ -19,7 +19,7 @@ R = 3
 ET = 1 << 20  # no timer elections inside the differential envelope
 
 
-def make_pair(groups=2, merged_deliver=False):
+def make_pair(groups=2, deliver_shape="lanes"):
     cfg = BatchedConfig(
         num_groups=groups,
         num_replicas=R,
@@ -29,11 +29,11 @@ def make_pair(groups=2, merged_deliver=False):
         election_timeout=ET,
         heartbeat_timeout=1,
         max_inflight=1 << 20,
-        merged_deliver=merged_deliver,
+        deliver_shape=deliver_shape,
     )
     eng = MultiRaftEngine(cfg)
     shadows = [ShadowCluster(R, election_timeout=ET, heartbeat_timeout=1,
-                             merged_deliver=merged_deliver)
+                             deliver_shape=deliver_shape)
                for _ in range(groups)]
     return cfg, eng, shadows
 
@@ -111,9 +111,9 @@ def run_lockstep(cfg, eng, shadows, schedule):
             )
 
 
-@pytest.mark.parametrize("merged", [False, True])
-def test_election_and_replication_lockstep(merged):
-    cfg, eng, shadows = make_pair(groups=2, merged_deliver=merged)
+@pytest.mark.parametrize("shape", ["lanes", "merged", "vectorized"])
+def test_election_and_replication_lockstep(shape):
+    cfg, eng, shadows = make_pair(groups=2, deliver_shape=shape)
     schedule = (
         [{"campaign": [(0, 0), (1, 2)]}]
         + [{} for _ in range(4)]
@@ -135,7 +135,7 @@ def test_partition_divergence_and_heal_lockstep():
     a new leader at a higher term; on heal the old leader's divergent
     tail is truncated via the reject-hint probe path
     (ref: raft.go:1109-1236)."""
-    cfg, eng, shadows = make_pair(groups=1, merged_deliver=True)
+    cfg, eng, shadows = make_pair(groups=1, deliver_shape="merged")
     iso0 = [(0, 0)]
     schedule = (
         [{"campaign": [(0, 0)]}]

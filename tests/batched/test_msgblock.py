@@ -619,6 +619,38 @@ class TestOldNewCodecEquivalence:
                         (eb.index, eb.term, eb.type, eb.data)
 
 
+class TestLaneOrderContract:
+    """The inbox lane-order contract (step.NUM_REQ_KINDS) is ONE
+    constant with three consumers — emit's response scatter, route's
+    lane pass-through, and every deliver shape's request/response
+    split. This test pins the contract itself so a drifted call site
+    fails here instead of silently crossing lanes (the ISSUE 14 small
+    fix: the three call sites used to agree by folklore)."""
+
+    def test_response_lane_offsets(self):
+        from etcd_tpu.batched import step as S
+
+        assert S.NUM_KINDS == 2 * S.NUM_REQ_KINDS
+        # Kind enums: responses sit exactly NUM_REQ_KINDS above their
+        # request lanes.
+        assert (S.KIND_VOTE_RESP, S.KIND_APP_RESP, S.KIND_HB_RESP) == \
+            tuple(k + S.NUM_REQ_KINDS
+                  for k in (S.KIND_VOTE, S.KIND_APP, S.KIND_HB))
+        # Wire-type routing (LANE_OF, shared with the msgblock codec):
+        # each response TYPE lands in its request type's lane + offset.
+        for req, resp in ((S.T_VOTE, S.T_VOTE_RESP),
+                          (S.T_PREVOTE, S.T_PREVOTE_RESP),
+                          (S.T_APP, S.T_APP_RESP),
+                          (S.T_HB, S.T_HB_RESP)):
+            assert LANE_OF[resp] == LANE_OF[req] + S.NUM_REQ_KINDS, (
+                req, resp)
+        # Request types occupy exactly the first NUM_REQ_KINDS lanes.
+        req_lanes = {int(LANE_OF[t]) for t in (
+            S.T_VOTE, S.T_PREVOTE, S.T_APP, S.T_SNAP, S.T_HB,
+            S.T_TIMEOUT_NOW)}
+        assert req_lanes == set(range(S.NUM_REQ_KINDS))
+
+
 class TestPackOutbox:
     """The device-side packer (step.pack_outbox) must agree with the
     reference per-field collect (collect_block) record for record."""

@@ -236,3 +236,10 @@ class TestNarrowLanes:
         assert np.asarray(narrow.state.role).dtype == np.int8
         assert np.asarray(narrow.state.inflight).dtype == np.int16
         assert np.asarray(narrow.state.term).dtype == np.int32  # wide
+        # ISSUE 14: the message path narrows too (step.NARROW_MSG_DTYPES
+        # — the routed inbox carries int8 wire types / int16 entry
+        # counts between rounds; the protocol words stay int32).
+        assert np.asarray(narrow.inbox.type).dtype == np.int8
+        assert np.asarray(narrow.inbox.n_ents).dtype == np.int16
+        assert np.asarray(narrow.inbox.term).dtype == np.int32
+        assert np.asarray(wide.inbox.type).dtype == np.int32

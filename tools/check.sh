@@ -41,6 +41,9 @@ python tools/rebalance_smoke.py
 echo "== walpipe smoke (async group-commit WAL pipeline, fsync coverage > 1) =="
 python tools/walpipe_smoke.py
 
+echo "== fused-round smoke (all deliver shapes agree, transfer guard disallow) =="
+python tools/fused_smoke.py
+
 echo "== bench history (artifacts/bench_history.json + BENCH_HISTORY.md) =="
 python tools/bench_history.py
 

@@ -67,6 +67,11 @@ def main() -> int:
     ap.add_argument("--groups", type=int, default=512)
     ap.add_argument("--layout", choices=("minor", "major"),
                     default="minor")
+    ap.add_argument("--deliver-shape",
+                    choices=("auto", "lanes", "merged", "vectorized"),
+                    default="auto",
+                    help="deliver shape to probe (auto = the platform "
+                         "default, state.default_deliver_shape)")
     ap.add_argument("--rounds", type=int, default=32)
     ap.add_argument("--out-dir", default="artifacts")
     ap.add_argument("--xprof", default="", metavar="DIR",
@@ -81,7 +86,8 @@ def main() -> int:
         max_props_per_round=2, election_timeout=1 << 20,
         heartbeat_timeout=4, auto_compact=True,
         lanes_minor=args.layout == "minor",
-    )
+        deliver_shape=args.deliver_shape,
+    ).resolved()
     eng = MultiRaftEngine(cfg)
     eng.campaign([i * 3 for i in range(g)])
     eng.run_rounds(4, tick=False)
@@ -101,10 +107,16 @@ def main() -> int:
     # lanes_minor transpose belongs to the fused round, measured via
     # the full-round reference below).
     phase_fns = {
+        # deliver takes the batch-level lane-occupancy vector exactly
+        # as the production round does (computed outside the vmap →
+        # the vectorized shape's lane skips stay real branches).
         "deliver": (
-            jax.jit(jax.vmap(
-                lambda iid, slot, sti, inb:
-                step_mod._deliver_all(cfg, iid, slot, sti, inb))),
+            jax.jit(lambda _iids, _slots, _st, _inbox: jax.vmap(
+                lambda iid, slot, sti, inb, la:
+                step_mod._deliver_all(cfg, iid, slot, sti, inb, la),
+                in_axes=(0, 0, 0, 0, None))(
+                _iids, _slots, _st, _inbox,
+                jnp.any(_inbox.valid, axis=(0, 1)))),
             (iids, slots, st, inbox)),
         "tick": (
             jax.jit(jax.vmap(
@@ -172,6 +184,7 @@ def main() -> int:
     result = {
         "metric": "round_segment_attribution",
         "config": (f"G={g} R=3 W=32 E=4 layout={args.layout} "
+                   f"deliver={cfg.deliver_shape} "
                    f"platform={backend.platform}"),
         "device": str(backend),
         "rounds_per_segment": args.rounds,
