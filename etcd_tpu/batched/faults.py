@@ -17,6 +17,17 @@ thousands of groups per member. Three planes:
   ``raftAfterSave``, ref: etcdserver/raft.go raftBeforeSave &c) armed to
   ``MultiRaftMember.crash()``, plus torn-tail injection (truncate the
   last WAL segment at an arbitrary byte inside the written prefix).
+* **disk faults** (ISSUE 15) — ``DiskFaultPlan``, an errfs-style shim
+  at the ``native/walog.py`` + ``storage/snap.py`` file-op seam:
+  one-shot/sticky fsync and write errors, sticky ENOSPC (armed/healed
+  so the write-back-pressure contract is testable end to end), per-op
+  latency injection (slow-disk as a *fault* — the gray-failure limp),
+  and seeded at-rest bit-flips in mid-log records
+  (``ChaosHarness.bit_rot``). The contract the shim tests lives in
+  hosting.py: first failed fsync ⇒ member fail-stop releasing nothing
+  from the failed window; ENOSPC at the seam ⇒ back-pressure that
+  recovers with zero acked loss; mid-log CRC corruption ⇒ salvage +
+  fenced boot + snapshot/probe heal.
 * **process faults** — scripted kill/restart cycles: ``crash()`` then a
   fresh member on the same data_dir, booting through ``_replay``.
 
@@ -42,6 +53,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..native.walog import DiskFullError, InjectedIOError
 from ..pkg import failpoint
 from ..pkg.failpoint import FailpointPanic
 from .hosting import (
@@ -52,6 +64,7 @@ from .hosting import (
     wait_group_leaders,
 )
 from .state import BatchedConfig, LEADER
+from .telemetry import disk_fault_injected_counter
 
 _log = logging.getLogger("etcd_tpu.batched.faults")
 
@@ -145,6 +158,165 @@ class FaultPlan:
         elif r.random() < sp.reorder:
             delay = r.uniform(0.0005, 0.005)
         return drop, copies, delay
+
+
+class _MemberDiskState:
+    """Armed disk faults for one member (DiskFaultPlan internal)."""
+
+    __slots__ = ("fsync_errors", "fsync_sticky", "write_errors",
+                 "write_sticky", "enospc", "delay_s", "delay_ops")
+
+    def __init__(self) -> None:
+        self.fsync_errors = 0
+        self.fsync_sticky = False
+        self.write_errors = 0
+        self.write_sticky = False
+        self.enospc = False
+        self.delay_s = 0.0
+        self.delay_ops: Tuple[str, ...] = ("fsync",)
+
+
+class DiskFaultPlan:
+    """Deterministic storage-fault decisions at the Walog/Snapshotter
+    file-op seam (the errfs idea from "Can Applications Recover from
+    fsync Failures?", ATC'19, as a Python shim): ``hook_for(mid)``
+    returns the per-member ``fault_hook(op, nbytes)`` a member threads
+    into its WAL handle; arming methods flip what the hook does.
+    Seeded like FaultPlan — the seed scopes the derived rngs (bit-flip
+    placement) so a failing episode replays from its seed.
+
+    Faults raise AT THE SEAM, before the native call starts, which is
+    what makes hosting's contracts sound: a DiskFullError provably
+    wrote nothing (retry-same-record is legal), an InjectedIOError at
+    op="fsync" models the kernel failing fdatasync with the dirty
+    pages' fate unknown (fail-stop is the only safe answer). Latency
+    injection sleeps at the seam — pure IO wait, generalizing
+    ETCD_TPU_FSYNC_DELAY_MS to a per-member, per-op, runtime-armable
+    fault (the gray-failure limp)."""
+
+    def __init__(self, seed: int) -> None:
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._state: Dict[int, _MemberDiskState] = {}
+        self._stats: Dict[str, int] = defaultdict(int)
+        self._c_injected = disk_fault_injected_counter()
+
+    def derived_rng(self, tag: str) -> random.Random:
+        return random.Random(f"{self.seed}/disk/{tag}")
+
+    def _st(self, mid: int) -> _MemberDiskState:
+        st = self._state.get(mid)
+        if st is None:
+            st = self._state[mid] = _MemberDiskState()
+        return st
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    # -- arming ----------------------------------------------------------------
+
+    def arm_fsync_error(self, mid: int, count: int = 1,
+                        sticky: bool = False) -> None:
+        """Fail the member's next `count` fsyncs (or EVERY fsync when
+        sticky) — the ATC'19 fault. The contract under test: the FIRST
+        failure fail-stops the member; one-shot vs sticky only matters
+        to stacks that (wrongly) retry."""
+        with self._lock:
+            st = self._st(mid)
+            st.fsync_errors = int(count)
+            st.fsync_sticky = bool(sticky)
+
+    def arm_write_error(self, mid: int, count: int = 1,
+                        sticky: bool = False) -> None:
+        with self._lock:
+            st = self._st(mid)
+            st.write_errors = int(count)
+            st.write_sticky = bool(sticky)
+
+    def arm_enospc(self, mid: int) -> None:
+        """Sticky disk-full on the member's WRITE path (append/flush,
+        never fsync): writes refuse until heal_enospc — the graceful
+        back-pressure episode."""
+        with self._lock:
+            self._st(mid).enospc = True
+
+    def heal_enospc(self, mid: int) -> None:
+        """Space returns: the member's dwelling write retries succeed
+        and it resumes with zero acked loss."""
+        with self._lock:
+            self._st(mid).enospc = False
+
+    def set_limp(self, mid: int, delay_s: float,
+                 ops: Tuple[str, ...] = ("fsync",)) -> None:
+        """Make the member LIMP: every op in `ops` takes an extra
+        delay_s of pure IO wait. Not an error — the member stays alive
+        and correct, just slow: the gray-failure shape the
+        member_limping detector + rebalancer eviction close the loop
+        on."""
+        with self._lock:
+            st = self._st(mid)
+            st.delay_s = float(delay_s)
+            st.delay_ops = tuple(ops)
+
+    def heal_limp(self, mid: int) -> None:
+        with self._lock:
+            st = self._st(mid)
+            st.delay_s = 0.0
+
+    def quiesce(self) -> None:
+        """Episode end: clear every armed fault (mirrors
+        FaultPlan.quiesce)."""
+        with self._lock:
+            self._state.clear()
+
+    # -- the seam --------------------------------------------------------------
+
+    def hook_for(self, mid: int) -> Callable[[str, int], None]:
+        def hook(op: str, nbytes: int, _mid: int = mid) -> None:
+            self._decide(_mid, op, nbytes)
+
+        return hook
+
+    def _decide(self, mid: int, op: str, nbytes: int) -> None:
+        delay = 0.0
+        err: Optional[Exception] = None
+        kind = None
+        with self._lock:
+            st = self._state.get(mid)
+            if st is None:
+                return
+            if op in st.delay_ops and st.delay_s > 0:
+                delay = st.delay_s
+            if op in ("fsync", "snap_fsync") and (
+                    st.fsync_sticky or st.fsync_errors > 0):
+                if not st.fsync_sticky:
+                    st.fsync_errors -= 1
+                kind = "fsync_error"
+                err = InjectedIOError(
+                    f"injected fsync failure (member {mid}, {op})")
+            elif op in ("append", "flush", "snap_write", "snap_rename"):
+                if st.enospc:
+                    kind = "enospc"
+                    err = DiskFullError(
+                        f"injected ENOSPC (member {mid}, {op})")
+                elif st.write_sticky or st.write_errors > 0:
+                    if not st.write_sticky:
+                        st.write_errors -= 1
+                    kind = "write_error"
+                    err = InjectedIOError(
+                        f"injected write failure (member {mid}, {op})")
+            if kind is not None:
+                self._stats[kind] += 1
+            if delay > 0:
+                self._stats["delay"] += 1
+        if kind is not None:
+            self._c_injected.labels(str(mid), op, kind).inc()
+        if delay > 0:
+            self._c_injected.labels(str(mid), op, "delay").inc()
+            time.sleep(delay)  # pure IO wait, outside the plan lock
+        if err is not None:
+            raise err
 
 
 class FaultyFabric:
@@ -419,6 +591,11 @@ class ChaosHarness:
         self.wal_pipeline = bool(wal_pipeline)
         self.wal_group_max_delay = wal_group_max_delay
         self.plan = FaultPlan(seed, spec)
+        # Storage fault plane (ISSUE 15): every member's WAL handle is
+        # born with this plan's hook threaded in (restarts re-thread it
+        # in _boot), so fsync errors / ENOSPC / limp delays can be
+        # armed mid-episode without touching the member.
+        self.disk = DiskFaultPlan(seed)
         self.fabric = FaultyFabric(
             self.plan, incarnation_fn=self._member_incarnation,
             removed_fn=self.is_removed)
@@ -465,6 +642,7 @@ class ChaosHarness:
             fence=self.fence, trace=self.trace or None,
             wal_pipeline=self.wal_pipeline or None,
             wal_group_max_delay=self.wal_group_max_delay,
+            disk_fault_hook=self.disk.hook_for(mid),
         )
         if self.inproc is not None:
             self.inproc.attach(m)
@@ -660,6 +838,112 @@ class ChaosHarness:
             "(group %d, record at %d)", mid, segs[-1], size - cut,
             group, off)
         return size - cut, group
+
+    # -- disk faults (ISSUE 15) ------------------------------------------------
+
+    def bit_rot(self, mid: int) -> Tuple[int, int]:
+        """At-rest corruption: flip one seeded bit inside a MID-LOG
+        record of the crashed member's last WAL segment — not the tail
+        (the torn-tail cells own that), a record the chain already
+        fsync'd over. The native reader refuses such a log outright;
+        the contract under test is hosting._replay's salvage +
+        fenced-boot path. Returns (record_offset, byte_offset) of the
+        flip, or (-1, -1) when the segment is too short to hold a
+        strictly-mid-log record (caller should write more first)."""
+        from ..native.walog import segment_records
+
+        m = self.members[mid]
+        assert m._stopped.is_set(), "bit_rot needs a crashed member"
+        wal_dir = os.path.join(self.data_dir, f"member-{mid}", "wal")
+        segs = sorted(f for f in os.listdir(wal_dir)
+                      if f.endswith(".wal"))
+        assert segs, "no WAL segments to rot"
+        path = os.path.join(wal_dir, segs[-1])
+        recs = segment_records(path)
+        # Strictly mid-log: skip the CRC-seed record (index 0) and the
+        # last record; payload-carrying records only (an empty payload
+        # leaves nothing to flip).
+        candidates = [r for r in recs[1:-1] if r[2] > 0]
+        if not candidates:
+            return -1, -1
+        rng = self.disk.derived_rng(f"bitrot/{mid}")
+        off, _rt, ln, _padded = rng.choice(candidates)
+        byte_off = off + 12 + rng.randrange(ln)
+        with open(path, "r+b") as f:
+            f.seek(byte_off)
+            b = f.read(1)
+            f.seek(byte_off)
+            f.write(bytes([b[0] ^ (1 << rng.randrange(8))]))
+        _log.info("bit rot: member %d seg %s record at %d, byte %d "
+                  "flipped", mid, segs[-1], off, byte_off)
+        return off, byte_off
+
+    def wait_fail_stop(self, mid: int, timeout: float = 20.0) -> str:
+        """Wait for `mid` to die by the IO-error contract's fail-stop
+        arm (crash-shaped death with a recorded cause); tears down its
+        router like crash() does. Returns the recorded cause."""
+        m = self.members[mid]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if m._stopped.is_set():
+                break
+            time.sleep(0.01)
+        else:
+            raise TimeoutError(f"member {mid} never fail-stopped")
+        assert m._crashed, f"member {mid} stopped but not crash-style"
+        assert m._fail_stop_cause, \
+            f"member {mid} died without a fail-stop cause"
+        router = self.routers.pop(mid, None)
+        if router is not None:
+            router.stop()
+        return m._fail_stop_cause
+
+    def failstop_envelope(self, mid: int) -> None:
+        """Release-barrier audit for a fail-stopped member: replay its
+        WAL host-side and assert every apply it ever RELEASED is
+        covered by its durable log (checker.check_durability_envelope)
+        — an apply escaping the failed fsync's window would put
+        applied_index beyond what the log can replay. (Caveat: a
+        snapshot install in flight at the kill can legally bump
+        applied ahead of its record in pipeline mode; the
+        deterministic fail-stop cells don't install snapshots.)"""
+        from ..functional.checker import check_durability_envelope
+        from ..native.walog import (
+            WalogError,
+            read_all_classified,
+            salvage,
+        )
+        from .hosting import (
+            RT_ENTRY,
+            RT_ENTRY_BATCH,
+            RT_SNAPSHOT,
+            _iter_entry_batch,
+            _unpack_entry,
+            _unpack_snap,
+        )
+
+        m = self.members[mid]
+        assert m._stopped.is_set(), "envelope audit needs a dead member"
+        wal_dir = os.path.join(self.data_dir, f"member-{mid}", "wal")
+        try:
+            records, _ts = read_all_classified(wal_dir)
+        except WalogError:
+            assert salvage(wal_dir) is not None
+            records, _ts = read_all_classified(wal_dir)
+        durable: Dict[int, int] = {}
+        for rtype, data, _seq, _meta in records:
+            if rtype == RT_ENTRY:
+                g, i, _t, _d, _et = _unpack_entry(data)
+                durable[g] = max(durable.get(g, 0), i)
+            elif rtype == RT_ENTRY_BATCH:
+                for g, i, _t, _d, _et in _iter_entry_batch(data):
+                    durable[g] = max(durable.get(g, 0), i)
+            elif rtype == RT_SNAPSHOT:
+                g, i, _t, _d, _et = _unpack_snap(data)
+                durable[g] = max(durable.get(g, 0), i)
+        applied = {g: int(a) for g, a in enumerate(m.applied_index)
+                   if a > 0}
+        check_durability_envelope(applied, durable)
 
     # -- workload --------------------------------------------------------------
 

@@ -59,10 +59,13 @@ import numpy as np
 
 from ..native.walog import (
     TAIL_CLEAN,
+    TAIL_CORRUPT,
     TAIL_NAMES,
     Walog,
     WalogError,
+    is_disk_full,
     read_all_classified as wal_read_all_classified,
+    salvage as wal_salvage,
 )
 from ..obs.tracer import make_tracer
 from ..pkg.failpoint import FailpointPanic, fp
@@ -84,6 +87,9 @@ from .state import BatchedConfig, LEADER
 from .step import T_SNAP
 from .telemetry import (
     TelemetryHub,
+    disk_fault_failstop_counter,
+    disk_fault_salvage_counter,
+    disk_full_gauge,
     fenced_groups_gauge,
     joint_groups_gauge,
     learner_slots_gauge,
@@ -336,6 +342,7 @@ class MultiRaftMember:
         wal_pipeline: Optional[bool] = None,
         wal_group_max_delay: Optional[float] = None,
         wal_group_max_bytes: Optional[int] = None,
+        disk_fault_hook: Optional[Callable[[str, int], None]] = None,
     ) -> None:
         self.id = member_id
         self.slot = member_id - 1
@@ -414,6 +421,21 @@ class MultiRaftMember:
         self._tail_state: Optional[int] = None  # walog TAIL_* at boot
         self._boot_fenced = 0
         self._g_fenced = fenced_groups_gauge().labels(str(member_id))
+
+        # IO-error contract state (ISSUE 15). disk_fault_hook is the
+        # storage fault plane's seam, threaded into the Walog handle
+        # below; _disk_full flips while WAL writes refuse at that seam
+        # with an ENOSPC-class error (write-back-pressure: proposals
+        # refuse, nothing acks, recovery is automatic once space
+        # returns); _fail_stop_cause records why a storage fault
+        # crash-killed this member (health op surfaces both);
+        # _salvage records an at-rest-corruption amputation at boot.
+        self._disk_fault_hook = disk_fault_hook
+        self._disk_full = False
+        self._fail_stop_cause: Optional[str] = None
+        self._salvage: Optional[Dict] = None
+        self._g_disk_full = disk_full_gauge().labels(str(member_id))
+        self._c_failstop = disk_fault_failstop_counter()
 
         # Per-group membership configs (joint-consensus control plane,
         # ISSUE 11): the replicated log drives it — committed
@@ -515,7 +537,8 @@ class MultiRaftMember:
             os.path.isdir(wal_dir)
             and any(f.endswith(".wal") for f in os.listdir(wal_dir))
         )
-        self.wal = Walog(wal_dir, create=fresh)
+        self.wal = Walog(wal_dir, create=fresh,
+                         fault_hook=disk_fault_hook)
 
         self._stopped = threading.Event()
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
@@ -607,7 +630,33 @@ class MultiRaftMember:
         # repairing read (which truncates the mid-record evidence) —
         # the ordering protocol-aware recovery rests on, kept
         # unbreakable inside the walog helper.
-        records, self._tail_state = wal_read_all_classified(wal_dir)
+        try:
+            records, self._tail_state = wal_read_all_classified(wal_dir)
+        except WalogError:
+            # At-rest corruption (a COMPLETE record failing its CRC —
+            # bit-rot, not a torn crash tail): the native reader
+            # refuses by design. Salvage amputates the log at the
+            # first bad record; the durable-watermark pass below then
+            # fences exactly the groups whose acked bytes the cut
+            # destroyed, and they heal by snapshot/probe rejoin — the
+            # damage becomes protocol-visible instead of unbootable.
+            info = wal_salvage(wal_dir)
+            if info is None:
+                raise  # not a salvageable corruption: surface it
+            self._salvage = info
+            disk_fault_salvage_counter().labels(str(self.id)).inc()
+            _log.warning(
+                "member %d: at-rest WAL corruption — salvaged: %s "
+                "truncated at %d (%d bytes dropped, %d later "
+                "segment(s) removed); groups below their durable "
+                "watermark boot FENCED", self.id, info["segment"],
+                info["truncated_at"], info["bytes_dropped"],
+                len(info["removed_segments"]))
+            records, _ts = wal_read_all_classified(wal_dir)
+            # Keep the ORIGINAL classification: the console/health
+            # must report what the boot found, not the amputated
+            # aftermath.
+            self._tail_state = TAIL_CORRUPT
         for rtype, data, _seq, _meta in records:
             if rtype == RT_HARDSTATE:
                 g, term, vote, commit = _unpack_hs(data)
@@ -1013,33 +1062,22 @@ class MultiRaftMember:
                 if self._h_phase is not None:
                     self._h_phase["wal"].observe(dt)
                 return
-            for rt, data in records:
-                self.wal.append(rt, data)
-            if must_sync:
-                tf = time.perf_counter()
-                if self.tracer is not None:
-                    # fsync_wait is stamped at fsync START (the queue/
-                    # build half of the old fsync hop), fsync at
-                    # COMPLETION — one instant pair covers every traced
-                    # key the batch fsync covers.
-                    tw = time.monotonic_ns()
-                    for rd in batch:
-                        self.tracer.stamp_many(
-                            rd.traced_entries, "fsync_wait", tw)
-                self.wal.flush(sync=True)
-                self.stats["wal_fsyncs"] = (
-                    self.stats.get("wal_fsyncs", 0) + 1)
-                self.stats["fsync_s"] = (
-                    self.stats.get("fsync_s", 0.0)
-                    + time.perf_counter() - tf)
-                if self._h_fsync is not None:
-                    self._h_fsync.observe(time.perf_counter() - tf)
-                if self.tracer is not None:
-                    tns = time.monotonic_ns()
-                    for rd in batch:
-                        self.tracer.stamp_many(
-                            rd.traced_entries, "fsync", tns)
-            self._apply_wm_locked(wm, must_sync)
+            # Inline mode: snapshot the install generations under the
+            # SAME lock the records were built under. The WAL write
+            # below runs OUTSIDE _lock (handle serialized by _wal_io —
+            # required so an ENOSPC dwell back-pressures without
+            # wedging health()/crash()/stop() behind the member lock),
+            # so a MsgSnap install can land between build and fsync;
+            # the generation guard skips the then-stale mirror delta
+            # exactly like the pipeline path does.
+            gens = {row: int(self._snap_gen[row]) for row in wm}
+        if not self._wal_write_sync(records, must_sync, batch):
+            return  # fail-stopped / crashed / stopped mid-write:
+            # nothing from the unpersisted window is released
+        with self._lock:
+            if self._crashed:
+                return
+            self._apply_wm_locked(wm, must_sync, gens)
             lifts = self._fence_lift_locked()
         dt = time.perf_counter() - t0
         self.stats["wal_s"] += dt
@@ -1050,6 +1088,136 @@ class MultiRaftMember:
         fp(self._fp_after_save)  # crash-after-save-before-apply site
         for rd in batch:
             self._apply_and_send(rd)
+
+    # -- IO-error contract (ISSUE 15) ------------------------------------------
+    #
+    # Three arms, applied identically to the inline drain and the
+    # WAL-pipeline worker:
+    #
+    # * **fail-stop** — the FIRST failed fsync (any errno) kills the
+    #   member crash-style: nothing gated on the failed window (acks,
+    #   sends, applies) is ever released, and no code path retries an
+    #   fsync whose dirty pages the kernel may already have dropped
+    #   and marked clean ("Can Applications Recover from fsync
+    #   Failures?", Rebello et al., ATC'19 — retry-fsync reports
+    #   success without durability on ext4/xfs). Unrecoverable write
+    #   errors (partial native write, injected write faults) take the
+    #   same arm: the on-disk suffix is unknowable.
+    # * **write-back-pressure** — an ENOSPC-class error raised AT THE
+    #   FAULT SEAM (DiskFullError: provably nothing was written) puts
+    #   the member in disk_full: proposals refuse, the round loop
+    #   back-pressures behind the bounded ready queue, health reports
+    #   it, and the SAME record retries until space returns — zero
+    #   acked writes lost, no crash-loop.
+    # * **fence-on-salvage** — at-rest CRC corruption found at boot is
+    #   amputated (walog.salvage) and the damaged groups boot FENCED
+    #   via the durable watermark (see _replay) — the ISSUE 5
+    #   machinery, reused.
+
+    def _wal_write_sync(self, records: List[Tuple[int, bytes]],
+                        must_sync: bool,
+                        batch: Sequence[BatchedReady]) -> bool:
+        """Inline-mode persistence with the IO-error contract applied.
+        Returns False when the member died (fail-stop/crash/stop)
+        before the batch was durable — the caller releases nothing."""
+        i = 0
+        while True:
+            try:
+                with self._wal_io:
+                    if self._wal_closed:
+                        return False
+                    while i < len(records):
+                        rt, data = records[i]
+                        self.wal.append(rt, data)
+                        i += 1
+            except Exception as e:  # noqa: BLE001 — classified below
+                if is_disk_full(e):
+                    self._enter_disk_full()
+                    if self._dwell_disk_full():
+                        continue  # retry the SAME record (seam
+                        # guarantees it never reached the buffer)
+                    return False
+                self._io_fail_stop("write", e)
+                return False
+            break
+        self._exit_disk_full()
+        if not must_sync:
+            return True
+        if self.tracer is not None:
+            # fsync_wait is stamped at fsync START (the queue/build
+            # half of the old fsync hop), fsync at COMPLETION — one
+            # instant pair covers every traced key the batch covers.
+            tw = time.monotonic_ns()
+            for rd in batch:
+                self.tracer.stamp_many(rd.traced_entries, "fsync_wait",
+                                       tw)
+        tf = time.perf_counter()
+        try:
+            with self._wal_io:
+                if self._wal_closed:
+                    return False
+                self.wal.flush(sync=True)
+        except Exception as e:  # noqa: BLE001 — first failed fsync
+            self._io_fail_stop("fsync", e)
+            return False
+        dt = time.perf_counter() - tf
+        self.stats["wal_fsyncs"] = self.stats.get("wal_fsyncs", 0) + 1
+        self.stats["fsync_s"] = self.stats.get("fsync_s", 0.0) + dt
+        if self._h_fsync is not None:
+            self._h_fsync.observe(dt)
+        if self.fleet is not None:
+            # Gray-failure feed: the fleet hub watches sustained fsync
+            # latency and raises the counted member_limping anomaly
+            # the rebalancer evicts leadership on.
+            self.fleet.observe_fsync(dt)
+        if self.tracer is not None:
+            tns = time.monotonic_ns()
+            for rd in batch:
+                self.tracer.stamp_many(rd.traced_entries, "fsync", tns)
+        return True
+
+    def _enter_disk_full(self) -> None:
+        if self._disk_full:
+            return
+        self._disk_full = True
+        self._g_disk_full.set(1)
+        self.stats["disk_full_episodes"] = (
+            self.stats.get("disk_full_episodes", 0) + 1)
+        _log.warning(
+            "member %d: WAL write hit ENOSPC — entering disk_full "
+            "write-back-pressure (proposals refuse, nothing acks, "
+            "resumes when space returns)", self.id)
+
+    def _exit_disk_full(self) -> None:
+        if not self._disk_full:
+            return
+        self._disk_full = False
+        self._g_disk_full.set(0)
+        _log.info("member %d: disk space returned — writes resumed",
+                  self.id)
+
+    def _dwell_disk_full(self) -> bool:
+        """One back-pressure dwell; False once the member died (the
+        batch is abandoned like any crash-torn suffix)."""
+        self.stats["disk_full_waits"] = (
+            self.stats.get("disk_full_waits", 0) + 1)
+        time.sleep(0.05)
+        return not (self._crashed or self._stopped.is_set())
+
+    def _io_fail_stop(self, stage: str, exc: BaseException) -> None:
+        """Fail-stop arm of the IO-error contract: record the cause,
+        count it, and die crash-style (WAL handle torn down, NO orderly
+        flush) so nothing gated on the failed window is released and
+        nothing ever re-fsyncs over possibly-dropped dirty pages.
+        Never called with _lock or _wal_io held (crash() takes both)."""
+        if self._crashed:
+            return
+        self._fail_stop_cause = f"{stage}: {exc}"[:200]
+        self._c_failstop.labels(str(self.id), stage).inc()
+        _log.error(
+            "member %d: storage %s failed (%s) — FAIL-STOP: nothing "
+            "from the failed window is released", self.id, stage, exc)
+        self.crash()
 
     # -- WAL-commit worker (async group-commit pipeline, ISSUE 13) -------------
 
@@ -1119,13 +1287,34 @@ class MultiRaftMember:
         around every handle touch (crash()/stop() close under it) and
         _lock only for the mirror fold."""
         must_sync = any(g.must_sync for g in wave)
-        with self._wal_io:
-            if self._wal_closed:
-                return  # crashed: the wave is torn away like a real kill
-            for g in wave:
-                for rt, data in g.records:
-                    self.wal.append(rt, data)
-            self.wal.flush(sync=False)  # bytes to the fd; NOT yet durable
+        recs = [rec for g in wave for rec in g.records]
+        i = 0
+        while True:
+            try:
+                with self._wal_io:
+                    if self._wal_closed:
+                        return  # crashed: wave torn away like a kill
+                    while i < len(recs):
+                        rt, data = recs[i]
+                        self.wal.append(rt, data)
+                        i += 1
+                    # bytes to the fd; NOT yet durable
+                    self.wal.flush(sync=False)
+            except Exception as e:  # noqa: BLE001 — IO-error contract
+                if is_disk_full(e):
+                    # ENOSPC at the fault seam (nothing written):
+                    # back-pressure OUTSIDE _wal_io so crash()/stop()
+                    # can still take the handle lock, then retry the
+                    # SAME record. The wave's acks stay withheld the
+                    # whole time — the release barrier below never ran.
+                    self._enter_disk_full()
+                    if self._dwell_disk_full():
+                        continue
+                    return
+                self._io_fail_stop("write", e)
+                return
+            break
+        self._exit_disk_full()
         # The pipeline's chaos window: records written, fsync pending,
         # nothing released/acked. Outside _wal_io so a crash() action
         # at the site can take _lock -> _wal_io itself.
@@ -1133,10 +1322,19 @@ class MultiRaftMember:
         tw_ns = time.monotonic_ns()  # fsync start (fsync_wait stamp)
         tf = time.perf_counter()
         if must_sync:
-            with self._wal_io:
-                if self._wal_closed:
-                    return
-                self.wal.flush(sync=True)
+            try:
+                with self._wal_io:
+                    if self._wal_closed:
+                        return
+                    self.wal.flush(sync=True)
+            except Exception as e:  # noqa: BLE001 — first failed fsync
+                # Fail-stop releasing NOTHING covered by the failed
+                # window: every batch queued behind this group-commit
+                # keeps its acks/sends/applies withheld forever
+                # (ATC'19: a retried fsync can report success over
+                # already-dropped dirty pages).
+                self._io_fail_stop("fsync", e)
+                return
         dt_sync = time.perf_counter() - tf
         td_ns = time.monotonic_ns()  # fsync completion (fsync stamp)
         lifts: List[int] = []
@@ -1156,6 +1354,10 @@ class MultiRaftMember:
                 self.stats.get("fsync_s", 0.0) + dt_sync)
             if self._h_fsync is not None:
                 self._h_fsync.observe(dt_sync)
+            if self.fleet is not None:
+                # Gray-failure feed (see _wal_write_sync): sustained
+                # slow group-commits raise member_limping.
+                self.fleet.observe_fsync(dt_sync)
             # Amortization accounting rides the fsyncs only: an idle
             # no-sync wave covering empty rounds must not inflate the
             # rounds-per-fsync ratio the pipeline is judged by.
@@ -1198,6 +1400,7 @@ class MultiRaftMember:
         t0 = time.perf_counter()
         conf_changed: List[int] = []
         auto_leave_rows: List[int] = []
+        io_fail: Optional[Tuple[str, BaseException]] = None
         with self._lock:
             if self._crashed:
                 return  # re-check under _lock: crash() closed the WAL
@@ -1236,7 +1439,27 @@ class MultiRaftMember:
                     self._wal_submit_locked([(RT_CONF_BATCH, packed)],
                                             must_sync=False)
                 else:
-                    self.wal.append(RT_CONF_BATCH, packed)
+                    try:
+                        with self._wal_io:
+                            if not self._wal_closed:
+                                self.wal.append(RT_CONF_BATCH, packed)
+                    except Exception as e:  # noqa: BLE001 — IO contract
+                        if is_disk_full(e):
+                            # Can't dwell under _lock: SKIP the record.
+                            # Safe by the same argument as a crash
+                            # before it lands — the config re-derives
+                            # from the (already-fsync'd) conf entries
+                            # at _replay; the next conf change or
+                            # snapshot re-records full state.
+                            self._enter_disk_full()
+                            self.stats["conf_rec_skipped"] = (
+                                self.stats.get("conf_rec_skipped", 0)
+                                + 1)
+                        else:
+                            # Unrecoverable write fault: defer the
+                            # fail-stop to after the lock release
+                            # (crash() takes _lock itself).
+                            io_fail = ("write", e)
                 # Stage the device masks UNDER the same lock as the
                 # conf mutation (member._lock -> rn._lock nesting is
                 # established — install_snapshot_state does the same):
@@ -1281,6 +1504,9 @@ class MultiRaftMember:
                         data=self.kvs[row].snapshot(),
                     )
                 out.append((row, m))
+        if io_fail is not None:
+            self._io_fail_stop(*io_fail)
+            return
         if conf_changed:
             self._post_conf_apply(conf_changed, auto_leave_rows)
         # Apply instant captured here, stamped at the END of this
@@ -1643,6 +1869,14 @@ class MultiRaftMember:
         return {
             "wal_pipeline": wal_pipe,
             "fence_enabled": self.fence_enabled,
+            # IO-error contract visibility (ISSUE 15): live ENOSPC
+            # back-pressure, the fail-stop cause when a storage fault
+            # killed this member, and the boot-time salvage record for
+            # at-rest corruption amputations.
+            "disk_full": self._disk_full,
+            "disk_full_waits": int(self.stats.get("disk_full_waits", 0)),
+            "fail_stop": self._fail_stop_cause,
+            "salvage": self._salvage,
             "wal_tail": (TAIL_NAMES.get(self._tail_state, "unknown")
                          if self._tail_state is not None else "fresh"),
             "fenced_groups": [int(g) for g in fenced],
@@ -1671,6 +1905,7 @@ class MultiRaftMember:
             # stale entries into the freshly restored state.
             idx = m.snapshot.metadata.index
             lifts: List[int] = []
+            fail: Optional[Tuple[str, BaseException]] = None
             with self._lock:
                 if self._stopped.is_set():
                     # Re-check under _lock: a crash() that won the lock
@@ -1678,6 +1913,16 @@ class MultiRaftMember:
                     # to (the unlocked check above is advisory only).
                     return
                 if idx > self.applied_index[group]:
+                    if self._disk_full:
+                        # Write-back-pressured: drop the install BEFORE
+                        # any state mutates — an install that cannot be
+                        # WAL-recorded is a replay hole, and raft
+                        # re-sends snapshots (lossy-net semantics; the
+                        # dwell cannot run here, it would sit on _lock).
+                        self.stats["snap_dropped_disk_full"] = (
+                            self.stats.get("snap_dropped_disk_full", 0)
+                            + 1)
+                        return
                     snap_term = m.snapshot.metadata.term
                     self.kvs[group].restore(m.snapshot.data)
                     self.applied_index[group] = idx
@@ -1756,11 +2001,35 @@ class MultiRaftMember:
                             records, must_sync=True,
                             on_synced=_snap_mirrors)
                     else:
-                        for rt, d in records:
-                            self.wal.append(rt, d)
-                        self.wal.flush(sync=True)
-                        _snap_mirrors()
-                        lifts = self._fence_lift_locked()
+                        try:
+                            # _wal_io nested under _lock (the documented
+                            # order): the inline drain writes under
+                            # _wal_io WITHOUT _lock now, so the handle
+                            # needs its own serialization here too.
+                            with self._wal_io:
+                                if self._wal_closed:
+                                    return
+                                for rt, d in records:
+                                    self.wal.append(rt, d)
+                                self.wal.flush(sync=True)
+                        except Exception as e:  # noqa: BLE001
+                            # Storage fault mid-install (state already
+                            # mutated): fail-stop — the install is
+                            # all-or-nothing, and a disk-full dwell
+                            # here would sit on _lock. Deferred below:
+                            # crash() takes _lock itself.
+                            fail = ("snap_install", e)
+                        else:
+                            # Inline installs bump the generation too:
+                            # the drain's mirror fold now runs outside
+                            # _lock and guards on it (see
+                            # _process_readys).
+                            self._snap_gen[group] += 1
+                            _snap_mirrors()
+                            lifts = self._fence_lift_locked()
+            if fail is not None:
+                self._io_fail_stop(*fail)
+                return
             self._fence_lift_apply(lifts)
         self.rn.step(group, m)
         self._work.set()
@@ -1778,7 +2047,11 @@ class MultiRaftMember:
     def propose(self, group: int, payload: bytes) -> bool:
         """Propose on this member; returns False if this member isn't
         the group's leader (the caller redirects, like etcd clients
-        following leader hints)."""
+        following leader hints) — or while the member sits in ENOSPC
+        write-back-pressure (disk_full: accepting a proposal that can
+        never persist would just strand the client)."""
+        if self._disk_full:
+            return False
         if not self.rn.is_leader(group):
             return False
         self.rn.propose(group, payload)
@@ -1973,7 +2246,17 @@ class MultiRaftMember:
             with self._wal_io:
                 if self._wal_closed:
                     return  # crash() already tore the handle down
-                self.wal.flush(sync=True)
+                try:
+                    self.wal.flush(sync=True)
+                except (WalogError, OSError):
+                    # Storage fault at shutdown: skip the close-flush.
+                    # The unflushed suffix was never released/acked, so
+                    # losing it is the crash contract, not data loss —
+                    # and retrying an fsync here is exactly what the
+                    # IO-error contract forbids.
+                    _log.exception(
+                        "member %d: final WAL flush failed at stop",
+                        self.id)
                 if drainer_done and walworker_done:
                     # Never close the WAL under a live drain/WAL-commit
                     # worker — its next append would hit a closed file
@@ -2542,7 +2825,11 @@ class MultiRaftCluster:
                  trace: Optional[bool] = None,
                  wal_pipeline: Optional[bool] = None,
                  wal_group_max_delay: Optional[float] = None,
-                 wal_group_max_bytes: Optional[int] = None) -> None:
+                 wal_group_max_bytes: Optional[int] = None,
+                 disk_fault_hook_fn: Optional[
+                     Callable[[int], Optional[Callable[[str, int],
+                                                       None]]]] = None,
+                 ) -> None:
         self.router = InProcRouter()
         self.members: Dict[int, MultiRaftMember] = {}
         for mid in range(1, num_members + 1):
@@ -2552,6 +2839,11 @@ class MultiRaftCluster:
                 fence=fence, trace=trace, wal_pipeline=wal_pipeline,
                 wal_group_max_delay=wal_group_max_delay,
                 wal_group_max_bytes=wal_group_max_bytes,
+                # Storage fault plane seam (ISSUE 15): a per-member
+                # hook factory, e.g. DiskFaultPlan.hook_for.
+                disk_fault_hook=(disk_fault_hook_fn(mid)
+                                 if disk_fault_hook_fn is not None
+                                 else None),
             )
             self.router.attach(m)
             self.members[mid] = m

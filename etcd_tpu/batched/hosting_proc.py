@@ -91,7 +91,14 @@ class AdminServer:
         m = self.member
         op = req["op"]
         if op == "ping":
-            return {"ok": True, "id": m.id}
+            # Liveness probes are exactly what gray failures slip past
+            # (HotOS'17): a fail-stopped or disk-full member still
+            # answers this socket, so the ping carries the IO-error
+            # contract's state — orchestration can see "up but dead"
+            # and "up but write-stalled" without the full health op.
+            return {"ok": True, "id": m.id,
+                    "fail_stop": m._fail_stop_cause,
+                    "disk_full": m._disk_full}
         if op == "campaign":
             m.campaign(req["groups"])
             return {"ok": True}
@@ -207,7 +214,10 @@ class AdminServer:
             # Durability-fence visibility (protocol-aware torn-tail
             # recovery): per-group fenced state, the index gap still to
             # close to the durable watermark, and the boot WAL-tail
-            # classification (clean boundary vs mid-record break).
+            # classification (clean boundary vs mid-record break) —
+            # plus, since ISSUE 15, the IO-error contract's state:
+            # disk_full back-pressure, the fail-stop cause, and the
+            # boot-time salvage record for at-rest corruption.
             return {"ok": True, **m.health()}
         if op == "metrics":
             # Prometheus text exposition of the process registry —

@@ -159,6 +159,7 @@ class Rebalancer:
         self._clock = clock
         self._last_move: Dict[int, float] = {}  # group -> move instant
         self._seen_skew: Dict[int, int] = {}  # edge-detect anomaly counts
+        self._seen_limp: Dict[int, int] = {}  # edge-detect member_limping
 
     # -- observe ---------------------------------------------------------------
 
@@ -169,6 +170,8 @@ class Rebalancer:
         balance: Dict[int, int] = {}
         flagged: List[Tuple[int, str]] = []  # (group, reason), ordered
         fresh_skew = False
+        fresh_limp = False
+        limping: List[int] = []  # members CURRENTLY limping (level)
         groups = 0
         for mid in self.act.members():
             roll = self.act.rollup(mid)
@@ -181,6 +184,15 @@ class Rebalancer:
             if skew_n > self._seen_skew.get(mid, 0):
                 fresh_skew = True
             self._seen_skew[mid] = skew_n
+            # Gray-failure eviction input (ISSUE 15): the LEVEL signal
+            # (member still limping now) targets the drain; the counted
+            # edge triggers a pass even when the level flag flickers.
+            limp_n = int(counts.get("member_limping", 0))
+            if limp_n > self._seen_limp.get(mid, 0):
+                fresh_limp = True
+            self._seen_limp[mid] = limp_n
+            if (roll.get("limp") or {}).get("limping"):
+                limping.append(mid)
             for a in roll.get("anomaly_log", []):
                 if a.get("kind") == "commit_frozen" and "group" in a:
                     flagged.append((int(a["group"]), "commit_frozen"))
@@ -190,13 +202,24 @@ class Rebalancer:
         fair = total / max(len(balance), 1)
         ratio = (max(balance.values()) / fair
                  if balance and fair > 0 else 0.0)
+        # Balance among the HEALTHY members only: after an eviction the
+        # healthy survivors legitimately carry fair×R/(R-1) each — the
+        # convergence bar for a fleet with limping members is judged on
+        # this ratio, or a completed drain would read as fresh skew.
+        healthy = {m: b for m, b in balance.items() if m not in limping}
+        fair_h = sum(healthy.values()) / max(len(healthy), 1)
+        healthy_ratio = (max(healthy.values()) / fair_h
+                         if healthy and fair_h > 0 else 0.0)
         return {
             "members_seen": len(balance),
             "balance": balance,
             "groups": groups,
             "fair": fair,
             "ratio": ratio,
+            "healthy_ratio": healthy_ratio,
             "fresh_skew": fresh_skew,
+            "fresh_limp": fresh_limp,
+            "limping": limping,
             "flagged": flagged,
         }
 
@@ -204,16 +227,37 @@ class Rebalancer:
 
     def plan(self, view: Dict) -> Tuple[List[Move], int]:
         """Moves for one pass (may be empty), plus how many candidate
-        groups the per-group cooldown vetoed."""
+        groups the per-group cooldown vetoed. Two modes:
+
+        * **skew** (the ISSUE 11 loop): shave the most-loaded member
+          down to fair share, receivers filled to fair share.
+        * **evict** (gray-failure, ISSUE 15): a LIMPING member that
+          still leads anything is drained to ZERO — a limping leader
+          sits on every commit's critical path; as a follower the
+          quorum forms from the healthy members. Healthy receivers
+          split the drained load (limping members never receive —
+          without that exclusion, the next skew pass would refill the
+          slowest member in the fleet). Cooldown/caps apply unchanged:
+          a flapping limp signal degrades to a bounded drain, never
+          to churn.
+        """
         cfg = self.cfg
         balance = dict(view["balance"])
-        if (len(balance) < 2 or view["groups"] < cfg.min_groups
-                or view["fair"] <= 0):
+        if len(balance) < 2 or view["fair"] <= 0:
             return [], 0
-        if not (view["ratio"] > cfg.skew_ratio or view["fresh_skew"]):
-            return [], 0
-        donor = max(balance, key=lambda m: balance[m])
-        excess = balance[donor] - int(view["fair"] + 0.5)
+        limping = [m for m in view.get("limping", ())
+                   if balance.get(m, 0) > 0]
+        evict = bool(limping)
+        if not evict:
+            if (view["groups"] < cfg.min_groups
+                    or not (view["ratio"] > cfg.skew_ratio
+                            or view["fresh_skew"])):
+                return [], 0
+            donor = max(balance, key=lambda m: balance[m])
+            excess = balance[donor] - int(view["fair"] + 0.5)
+        else:
+            donor = max(limping, key=lambda m: balance[m])
+            excess = balance[donor]  # drain to zero
         if excess <= 0:
             return [], 0
         led = self.act.led_groups(donor)
@@ -234,20 +278,28 @@ class Rebalancer:
             else:
                 cooled.append(g)
         n = min(excess, cfg.max_moves_per_pass, len(cooled))
-        # Receivers by deficit, emptiest first; each receives up to its
-        # gap to fair share so one pass cannot overshoot into a new
-        # skew (the flap the cooldown alone would only slow down).
+        # Receivers by deficit, emptiest first — limping members are
+        # never receivers in EITHER mode. Skew mode fills each to fair
+        # share so one pass cannot overshoot into a new skew; evict
+        # mode splits the whole drain across the healthy members.
         moves: List[Move] = []
         receivers = sorted(
-            (m for m in balance if m != donor),
+            (m for m in balance
+             if m != donor and m not in view.get("limping", ())),
             key=lambda m: balance[m])
+        if not receivers:
+            return [], vetoed  # whole fleet limping: nowhere to move
+        evict_room = -(-n // len(receivers))  # ceil split
         gi = 0
         for to in receivers:
-            room = max(int(view["fair"] + 0.5) - balance[to], 0)
+            room = (evict_room if evict
+                    else max(int(view["fair"] + 0.5) - balance[to], 0))
             while room > 0 and gi < n:
                 g = cooled[gi]
-                moves.append(Move(group=g, frm=donor, to=to,
-                                  reason=reason_of.get(g, "fill")))
+                moves.append(Move(
+                    group=g, frm=donor, to=to,
+                    reason=("limp_evict" if evict
+                            else reason_of.get(g, "fill"))))
                 gi += 1
                 room -= 1
                 balance[to] += 1
@@ -298,13 +350,20 @@ class Rebalancer:
                         or time.monotonic() > deadline):
                     break
                 time.sleep(0.2)
+        # Gray-failure convergence: a limping member that still LEADS
+        # anything is unfinished business, whatever the ratio says.
+        undrained = [m for m in after.get("limping", ())
+                     if after["balance"].get(m, 0) > 0]
         report = {
             "triggered": bool(moves) or view["ratio"] > cfg.skew_ratio
-            or view["fresh_skew"],
+            or view["fresh_skew"] or bool(view.get("limping"))
+            or view.get("fresh_limp", False),
             "ratio_before": round(view["ratio"], 3),
             "ratio_after": round(after["ratio"], 3),
             "balance_before": view["balance"],
             "balance_after": after["balance"],
+            "limping": view.get("limping", []),
+            "limping_after": after.get("limping", []),
             "moves": [vars(mv) for mv in moves],
             "moved": sum(1 for mv in moves if mv.ok),
             "failed": sum(1 for mv in moves if not mv.ok),
@@ -312,9 +371,15 @@ class Rebalancer:
             "move_wall_s": round(time.monotonic() - t0, 3),
             "members_seen": after["members_seen"],
             # Zero reachable rollups is an observability outage, not a
-            # balanced cluster — never report it as convergence.
+            # balanced cluster — never report it as convergence. With
+            # limping members present, balance is judged among the
+            # healthy survivors (they legitimately carry the drained
+            # load).
             "converged": (after["members_seen"] > 0
-                          and after["ratio"] <= cfg.skew_ratio),
+                          and (after["healthy_ratio"]
+                               if after.get("limping")
+                               else after["ratio"]) <= cfg.skew_ratio
+                          and not undrained),
         }
         if moves:
             _log.info(

@@ -249,6 +249,65 @@ def learner_slots_gauge(
     ))
 
 
+def disk_fault_failstop_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    """Storage fail-stop events by stage (the ISSUE 15 IO-error
+    contract: the FIRST failed fsync — or any unrecoverable write —
+    kills the member crash-style, releasing nothing gated on the
+    failed window; never retry-fsync over possibly-dropped dirty
+    pages, per Rebello et al., ATC'19)."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_disk_fault_failstop_total",
+        "member fail-stops forced by storage faults, by stage "
+        "(write | fsync | snap_install)",
+        ("member", "stage"),
+    ))
+
+
+def disk_full_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    """1 while the member sits in ENOSPC write-back-pressure (WAL
+    appends refused at the fault seam before any byte was written):
+    proposals refuse, acks/sends stall behind the unwritten batch, and
+    the member resumes — zero acked writes lost — once space returns.
+    The health op's ``disk_full`` field mirrors it."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_disk_fault_disk_full",
+        "member currently in ENOSPC write-back-pressure (0/1)",
+        ("member",),
+    ))
+
+
+def disk_fault_injected_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    """Injected disk-fault decisions at the Walog/Snapshotter file-op
+    seam (batched/faults.DiskFaultPlan) — the fault plane must PROVE
+    it injected, same discipline as the message-fault counters."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_disk_fault_injected_total",
+        "injected disk faults at the storage seam, by op and kind "
+        "(kind: fsync_error | write_error | enospc | delay)",
+        ("member", "op", "kind"),
+    ))
+
+
+def disk_fault_salvage_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    """At-rest WAL corruption amputations performed at boot (walog
+    salvage: truncate at the first CRC-bad complete record, drop later
+    segments; the damaged groups boot FENCED via the durable
+    watermark)."""
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_disk_fault_salvage_total",
+        "at-rest WAL corruption salvage amputations at member boot",
+        ("member",),
+    ))
+
+
 def trace_span_counter(
         registry: Optional[pmet.Registry] = None) -> pmet.Counter:
     """Spans opened by the proposal-lifecycle tracer (etcd_tpu.obs) —

@@ -11,6 +11,7 @@ failpoints, and the tester loop is `run_case`.
 from .cluster import Cluster
 from .checker import (
     check_config_safety,
+    check_durability_envelope,
     check_leader_claims,
     check_sequential_history,
     committed_never_lost,
@@ -27,5 +28,5 @@ __all__ = [
     "hash_check", "lease_expire_check", "linearizable_check",
     "kv_map_hash", "multiraft_hash_check", "committed_never_lost",
     "check_leader_claims", "check_sequential_history",
-    "check_config_safety",
+    "check_config_safety", "check_durability_envelope",
 ]

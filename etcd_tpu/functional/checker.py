@@ -345,6 +345,24 @@ def check_config_safety(members: Sequence,
                 prev = ent
 
 
+def check_durability_envelope(applied: Dict[int, int],
+                              durable: Dict[int, int]) -> None:
+    """Release-barrier audit for a fail-stopped member (the ISSUE 15
+    IO-error contract): ``applied`` is the dead member's per-group
+    apply watermark at death, ``durable`` what its WAL can actually
+    replay (max entry/snapshot index per group). Every apply a member
+    ever RELEASES must ride a successful covering fsync — so an
+    ``applied[g] > durable[g]`` group means an ack/apply escaped the
+    failed window: exactly the ATC'19 failure (state served to clients
+    that recovery cannot reproduce). Pure function — the chaos harness
+    (faults.failstop_envelope) assembles both maps."""
+    bad = {g: (a, durable.get(g, 0)) for g, a in applied.items()
+           if a > durable.get(g, 0)}
+    assert not bad, (
+        "applies escaped the failed window (applied > durable log): "
+        f"{dict(list(bad.items())[:8])}")
+
+
 def check_sequential_history(
         history: List[Tuple],
 ) -> None:

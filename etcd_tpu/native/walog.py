@@ -16,8 +16,14 @@ from . import load
 # fsync is. Default 0 (off); benches that set it MUST label their
 # artifacts with it. This is how the async WAL pipeline's group-commit
 # win is measurable on boxes whose local disk syncs in microseconds.
-_FSYNC_DELAY_S = float(
-    os.environ.get("ETCD_TPU_FSYNC_DELAY_MS", "0") or 0) / 1e3
+# Read PER Walog INSTANCE (at __init__), not latched at import: tests
+# and benches vary it between members/episodes without a fresh
+# interpreter (the ISSUE 15 satellite fix).
+
+
+def _fsync_delay_s() -> float:
+    return float(
+        os.environ.get("ETCD_TPU_FSYNC_DELAY_MS", "0") or 0) / 1e3
 
 _REC_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_int,
@@ -69,11 +75,45 @@ class WalogError(Exception):
     pass
 
 
+class DiskFullError(WalogError):
+    """ENOSPC-class WRITE failure raised at the fault-hook seam before
+    the bytes touched the native buffer — provably nothing was written,
+    so the caller may back-pressure and retry the same record. A
+    failure surfacing from the native write/fsync itself never gets
+    this type: a partial write or a failed fsync leaves the on-disk /
+    page-cache state unknowable, and the IO-error contract
+    (hosting.py) fail-stops instead (ATC'19: never retry-fsync over
+    possibly-dropped dirty pages)."""
+
+
+class InjectedIOError(WalogError):
+    """Deterministic injected IO failure (DiskFaultPlan fsync/write
+    errors). Carries the op name so fail-stop accounting can label the
+    stage."""
+
+
+def is_disk_full(exc: BaseException) -> bool:
+    """Whether an exception is the retryable nothing-was-written
+    ENOSPC class (see DiskFullError)."""
+    return isinstance(exc, DiskFullError)
+
+
 class Walog:
-    """Segmented CRC-chained record log (native handle wrapper)."""
+    """Segmented CRC-chained record log (native handle wrapper).
+
+    ``fault_hook(op, nbytes)`` — the storage fault plane's seam
+    (batched/faults.DiskFaultPlan): called BEFORE every file-affecting
+    native call with op in {"append", "flush", "fsync"}. The hook may
+    sleep (per-op latency injection — the slow-disk-as-a-fault
+    generalization of ETCD_TPU_FSYNC_DELAY_MS) or raise
+    (DiskFullError / InjectedIOError); a raise at the seam guarantees
+    the native op was never started, which is what makes the
+    DiskFullError retry contract sound."""
 
     def __init__(self, dirpath: str, segment_bytes: int = 64 << 20,
-                 create: bool = False) -> None:
+                 create: bool = False,
+                 fault_hook: Optional[Callable[[str, int], None]] = None,
+                 ) -> None:
         self._lib = _lib()
         err = ctypes.create_string_buffer(512)
         self._h = self._lib.walog_open(
@@ -82,17 +122,25 @@ class Walog:
         if not self._h:
             raise WalogError(err.value.decode() or "walog_open failed")
         self.dirpath = dirpath
+        self.fault_hook = fault_hook
+        # Per-instance (NOT import-latched): a test/bench can flip the
+        # env var between member boots in one interpreter.
+        self._fsync_delay_s = _fsync_delay_s()
 
     def _check(self, rc: int) -> None:
         if rc < 0:
             raise WalogError(self._lib.walog_errmsg(self._h).decode())
 
     def append(self, rtype: int, data: bytes) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook("append", len(data))
         self._check(self._lib.walog_append(self._h, rtype, data, len(data)))
 
     def flush(self, sync: bool = True) -> int:
-        if sync and _FSYNC_DELAY_S > 0:
-            time.sleep(_FSYNC_DELAY_S)  # slow-disk emulation (see top)
+        if self.fault_hook is not None:
+            self.fault_hook("fsync" if sync else "flush", 0)
+        if sync and self._fsync_delay_s > 0:
+            time.sleep(self._fsync_delay_s)  # slow-disk emulation (see top)
         rc = self._lib.walog_flush(self._h, 1 if sync else 0)
         self._check(rc)
         return rc
@@ -219,3 +267,167 @@ def verify(dirpath: str) -> bool:
         return True
     except WalogError:
         return False
+
+
+# -- at-rest corruption salvage (ISSUE 15) ------------------------------------
+#
+# The native reader treats a COMPLETE record failing its CRC as a hard
+# error (walog.cc: "auto-truncating them would silently drop fsync'd
+# raft entries") — correct as a default, but it leaves a bit-flipped
+# at-rest record unbootable. The protocol-aware alternative (FAST'18):
+# amputate the log at the first corrupt record, boot, and let the
+# durable-watermark fence mark exactly the groups whose acked bytes the
+# amputation destroyed (hosting._replay already does that for torn
+# tails). salvage() is that amputation: a Python-side CRC32C chain walk
+# that truncates the damaged segment at the last good record boundary
+# and deletes every later segment, returning what it removed so the
+# caller can log/fence honestly. It never runs implicitly — the boot
+# path invokes it only after the native reader refused.
+
+_CRC32C_TABLE: Optional[List[int]] = None
+
+
+def _crc32c_table() -> List[int]:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else c >> 1
+            tbl.append(c)
+        _CRC32C_TABLE = tbl
+    return _CRC32C_TABLE
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C (Castagnoli), matching walog.cc's chain function.
+    Byte-at-a-time — recovery/tooling path only, never hot."""
+    tbl = _crc32c_table()
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = tbl[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _is_torn_region(data: bytes, off: int, padded: int) -> bool:
+    """Mirror of walog.cc is_torn_record: any >=8-byte disk-sector
+    piece of the record region being all zeros means a torn
+    preallocated-segment write, not at-rest corruption."""
+    end = min(off + padded, len(data))
+    pos = off
+    while pos < end:
+        piece_end = min((pos // 512 + 1) * 512, end)
+        if piece_end - pos >= 8 and not any(data[pos:piece_end]):
+            return True
+        pos = piece_end
+    return False
+
+
+def scan_chain(dirpath: str) -> Optional[dict]:
+    """Walk every segment's CRC chain Python-side; return the FIRST
+    at-rest corruption found as {"segment", "path", "offset"(=last good
+    boundary), "bad_record_off"} or None when the chain is clean/merely
+    torn (torn tails are the native repair's job, not salvage's)."""
+    import struct as _struct
+
+    segs = sorted(f for f in os.listdir(dirpath) if f.endswith(".wal"))
+    crc = 0
+    chain_started = False
+    for name in segs:
+        path = os.path.join(dirpath, name)
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        good = 0
+        first = True
+        while off + 12 <= len(data):
+            ln, rtype = _struct.unpack_from("<IB", data, off)
+            (rcrc,) = _struct.unpack_from("<I", data, off + 8)
+            padded = (12 + ln + 7) & ~7
+            if off + padded > len(data):
+                return None  # torn tail: native repair handles it
+            if first:
+                if rtype != 0:  # missing CRC-reset seed record
+                    return {"segment": name, "path": path,
+                            "offset": 0, "bad_record_off": off}
+                if not chain_started:
+                    crc = rcrc
+                    chain_started = True
+                elif rcrc != crc:
+                    # Chain mismatch across the segment boundary: the
+                    # seed itself is the damaged record.
+                    return {"segment": name, "path": path,
+                            "offset": 0, "bad_record_off": off}
+                first = False
+            else:
+                want = crc32c(data[off + 12:off + 12 + ln], crc)
+                if want != rcrc:
+                    if _is_torn_region(data, off, padded):
+                        return None  # torn, not corrupt
+                    return {"segment": name, "path": path,
+                            "offset": good, "bad_record_off": off}
+                crc = want
+            off += padded
+            good = off
+    return None
+
+
+def salvage(dirpath: str) -> Optional[dict]:
+    """Amputate at-rest corruption: truncate the damaged segment at the
+    last good record boundary and DELETE every later segment (their
+    chain seeds no longer match). Returns
+    {"segment", "truncated_at", "bytes_dropped", "removed_segments"}
+    or None when the chain held no complete-record corruption. The
+    caller owns the consequences: every fsync'd record at-or-beyond
+    the cut is gone, and only a durable-watermark fence
+    (hosting._replay) makes that loss protocol-visible instead of
+    silent."""
+    bad = scan_chain(dirpath)
+    if bad is None:
+        return None
+    segs = sorted(f for f in os.listdir(dirpath) if f.endswith(".wal"))
+    si = segs.index(bad["segment"])
+    if bad["offset"] == 0 and si == 0:
+        # The very first segment's SEED record is damaged: no valid
+        # prefix exists at all. Refuse — truncating to zero bytes
+        # would leave an unbootable husk after destroying the (intact)
+        # later segments, and booting EMPTY would forget the member's
+        # vote/term, re-opening double-vote windows. Total log loss is
+        # operator territory (rejoin as a fresh member), not salvage's.
+        return None
+    later = segs[si + 1:]
+    dropped = 0
+    if bad["offset"] == 0:
+        # A non-first segment's seed is the damaged record: nothing in
+        # this segment survives, but the chain through the PREVIOUS
+        # segment is whole — drop the damaged segment entirely (a
+        # zero-byte truncation would fail walog_open's seed check) and
+        # everything after it; the previous segment becomes the tail.
+        later = [bad["segment"]] + later
+    else:
+        size = os.path.getsize(bad["path"])
+        dropped += size - bad["offset"]
+        os.truncate(bad["path"], bad["offset"])
+        fd = os.open(bad["path"], os.O_RDWR)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    for name in later:
+        p = os.path.join(dirpath, name)
+        dropped += os.path.getsize(p)
+        os.remove(p)
+    # Make the amputation itself durable (file sizes + dir entries)
+    # before anyone replays the survivor prefix.
+    dfd = os.open(dirpath, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    return {
+        "segment": bad["segment"],
+        "truncated_at": bad["offset"],
+        "bytes_dropped": dropped,
+        "removed_segments": later,
+    }

@@ -208,8 +208,9 @@ def fleet_anomaly_counter(
     reg = registry or pmet.DEFAULT
     return reg.register(pmet.Counter(
         "etcd_tpu_fleet_anomalies_total",
-        "fleet anomaly flags raised from device summary frames "
-        "(kind: commit_frozen | leader_skew)",
+        "fleet anomaly flags raised from device summary frames and "
+        "host persistence signals "
+        "(kind: commit_frozen | leader_skew | member_limping)",
         ("member", "kind")))
 
 
@@ -255,6 +256,10 @@ def register_families(registry: Optional[pmet.Registry] = None) -> None:
     fleet_gauge("leader_skew_ratio",
                 "max leaders-per-slot over the fair share G/R (x1000)",
                 ("member",), registry)
+    fleet_gauge("fsync_ewma_ms",
+                "EWMA of this member's WAL fsync latency in ms x1000 "
+                "(the member_limping gray-failure signal)",
+                ("member",), registry)
     fleet_anomaly_counter(registry)
     fleet_frames_counter(registry)
 
@@ -275,7 +280,9 @@ class FleetHub:
                  dump_dir: Optional[str] = None,
                  freeze_frames: int = 8,
                  skew_ratio: float = 2.0,
-                 skew_min_groups: int = 16) -> None:
+                 skew_min_groups: int = 16,
+                 limp_ms: float = 25.0,
+                 limp_ops: int = 8) -> None:
         self.layout = FleetLayout(n_rows, num_replicas, num_groups)
         self.member = str(member)
         self.registry = registry or pmet.DEFAULT
@@ -283,6 +290,13 @@ class FleetHub:
         self.freeze_frames = int(freeze_frames)
         self.skew_ratio = float(skew_ratio)
         self.skew_min_groups = int(skew_min_groups)
+        # Gray-failure (limp) detection thresholds — mutable attrs so
+        # harnesses can tune per-episode without a rebuild.
+        self.limp_ms = float(limp_ms)
+        self.limp_ops = int(limp_ops)
+        self._fsync_ewma_ms: Optional[float] = None
+        self._limp_streak = 0
+        self._limping = False
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=int(ring))
         self._frames = 0
@@ -327,8 +341,61 @@ class FleetHub:
                                       reg).labels(m)
         self._g_skew = fleet_gauge("leader_skew_ratio", "",
                                    ("member",), reg).labels(m)
+        self._g_fsync_ewma = fleet_gauge("fsync_ewma_ms", "",
+                                         ("member",), reg).labels(m)
         self._c_anom = fleet_anomaly_counter(reg)
         self._c_frames = fleet_frames_counter(reg).labels(m)
+
+    # -- gray-failure (limp) signal -------------------------------------------
+
+    def observe_fsync(self, seconds: float) -> None:
+        """Host persistence signal: one WAL fsync's wall time (the
+        hosting layer calls this after every sync, inline or
+        group-commit). A member whose fsyncs stay above ``limp_ms`` for
+        ``limp_ops`` consecutive syncs is LIMPING — alive, acking,
+        and slow: the gray-failure shape Huang et al. (HotOS'17) show
+        health checks miss. Raises the counted ``member_limping``
+        anomaly once per degradation episode (edge-triggered, re-arms
+        after the member runs fast again), which the rebalancer
+        (batched/rebalance.py) consumes to drain leadership off this
+        member — as a follower it no longer holds any commit's
+        critical path, the quorum forms from the healthy members."""
+        ms = seconds * 1e3
+        fire = False
+        with self._lock:
+            prev = self._fsync_ewma_ms
+            self._fsync_ewma_ms = (
+                ms if prev is None else 0.2 * ms + 0.8 * prev)
+            ewma = self._fsync_ewma_ms
+            if ms > self.limp_ms:
+                self._limp_streak += 1
+                if (self._limp_streak >= self.limp_ops
+                        and not self._limping):
+                    self._limping = True
+                    fire = True
+            else:
+                self._limp_streak = 0
+                self._limping = False  # re-arms on heal
+            streak = self._limp_streak
+        self._g_fsync_ewma.set(round(ewma * 1000))
+        if fire:
+            self._raise_anomaly("member_limping", {
+                "fsync_ms": round(ms, 2),
+                "ewma_ms": round(ewma, 2),
+                "streak": streak,
+                "threshold_ms": self.limp_ms,
+            })
+
+    def limp_state(self) -> Dict:
+        with self._lock:
+            return {
+                "limping": self._limping,
+                "fsync_ewma_ms": (round(self._fsync_ewma_ms, 3)
+                                  if self._fsync_ewma_ms is not None
+                                  else None),
+                "slow_streak": self._limp_streak,
+                "threshold_ms": self.limp_ms,
+            }
 
     # -- ingest ---------------------------------------------------------------
 
@@ -504,6 +571,10 @@ class FleetHub:
             "ring_len": ring_len,
             "anomalies": anomalies,
             "anomaly_log": anomaly_log,
+            # Gray-failure signal (ISSUE 15): the rebalancer's
+            # eviction trigger — LEVEL (currently limping), not just
+            # the counted edge in `anomalies`.
+            "limp": self.limp_state(),
         }
         if f is not None:
             out.update({

@@ -71,9 +71,25 @@ def _decode(payload: bytes) -> Snapshot:
 
 
 class Snapshotter:
-    def __init__(self, dirpath: str) -> None:
+    """``fault_hook(op, nbytes)`` is the storage fault plane's seam
+    (batched/faults.DiskFaultPlan — same contract as native Walog's
+    hook): called BEFORE each file-affecting step with op in
+    {"snap_write", "snap_fsync", "snap_rename"}, so a raise guarantees
+    that step never started (the write-atomicity save_snap's tmp+rename
+    already provides makes any abort loss-free: the previous snapshot
+    file is untouched). The hook may sleep (latency injection) or
+    raise: ENOSPC/write errors fire on snap_write/snap_rename, fsync
+    errors on snap_fsync — exercised directly by
+    tests/batched/test_diskfaults.py's Snapshotter seam tests."""
+
+    def __init__(self, dirpath: str, *, fault_hook=None) -> None:
         self.dir = dirpath
+        self.fault_hook = fault_hook
         os.makedirs(dirpath, exist_ok=True)
+
+    def _hook(self, op: str, nbytes: int = 0) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook(op, nbytes)
 
     def save_snap(self, snapshot: Snapshot) -> None:
         """ref: snapshotter.go:82-139 SaveSnap/save."""
@@ -87,11 +103,25 @@ class Snapshotter:
         payload = _encode(snapshot)
         blob = struct.pack("<II", zlib.crc32(payload), len(payload)) + payload
         tmp = os.path.join(self.dir, fname + ".tmp")
+        self._hook("snap_write", len(blob))
         with open(tmp, "wb") as f:
             f.write(blob)
             f.flush()
+            self._hook("snap_fsync")
             os.fsync(f.fileno())
+        self._hook("snap_rename")
         os.replace(tmp, os.path.join(self.dir, fname))
+        # Crash-durability: fsync the parent directory after the
+        # rename, or a crash can lose the DIRECTORY ENTRY of a fully
+        # fsync'd snapshot file (the rename lives in the dir's pages,
+        # not the file's — ref: fileutil.Fsync after rename in the
+        # reference's snap/wal paths; ATC'19's fsync-failure study
+        # calls out exactly this class).
+        dfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
 
     def snap_names(self) -> List[str]:
         """Snapshot filenames, newest (highest term-index) first."""

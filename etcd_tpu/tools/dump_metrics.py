@@ -143,6 +143,14 @@ def dump_local(names_only: bool = False) -> int:
     btel.round_phase_histogram()
     btel.router_loss_counter()
     btel.fenced_groups_gauge()
+    # Storage fault plane (ISSUE 15): fail-stop/disk-full/injection/
+    # salvage families — the IO-error contract's observability face.
+    # (member_limping rides the fleet anomaly counter below; the limp
+    # signal gauge is etcd_tpu_fleet_fsync_ewma_ms.)
+    btel.disk_fault_failstop_counter()
+    btel.disk_full_gauge()
+    btel.disk_fault_injected_counter()
+    btel.disk_fault_salvage_counter()
     # Fleet observatory families (ISSUE 10): histograms + censuses +
     # anomaly counters fed from the device SummaryFrame; --watch picks
     # their deltas up like any other series once a member moves them.

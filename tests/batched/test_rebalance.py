@@ -33,12 +33,16 @@ class FakeActuator:
     def __init__(self, balance: Dict[int, int], groups: int,
                  flagged=None, frozen: bool = False,
                  transfer_ok: bool = True,
-                 bounce: bool = False) -> None:
+                 bounce: bool = False,
+                 limping=()) -> None:
         self.balance = dict(balance)
         self.reported = dict(balance)
         self.groups = groups
         self.flagged = flagged or []
         self.frozen = frozen
+        # Members whose rollups carry the gray-failure LEVEL signal
+        # (limp.limping=True) — the ISSUE 15 eviction input.
+        self.limping = set(limping)
         self.transfer_ok = transfer_ok
         # bounce: the transfer REPORTS done but leadership snaps back
         # (elections under load) — the cluster state never changes,
@@ -66,9 +70,13 @@ class FakeActuator:
             "member": str(mid),
             "groups": self.groups,
             "leaders_total": src[mid],
-            "anomalies": {},
+            "anomalies": ({"member_limping": 1}
+                          if mid in self.limping else {}),
             "anomaly_log": log if mid == self._donor() else [],
             "top": top if mid == self._donor() else [],
+            "limp": {"limping": mid in self.limping,
+                     "fsync_ewma_ms": 60.0 if mid in self.limping
+                     else 0.2},
         }
 
     def _donor(self) -> int:
@@ -248,3 +256,92 @@ def test_move_dataclass_shape():
     mv = Move(group=1, frm=2, to=3)
     assert vars(mv) == {"group": 1, "frm": 2, "to": 3, "attempts": 0,
                         "ok": False, "reason": ""}
+
+
+# -- gray-failure eviction (ISSUE 15) ------------------------------------------
+
+
+def test_limping_member_drained_to_zero():
+    """The eviction contract: a BALANCED cluster with one limping
+    member still drains that member completely — ratio never triggered,
+    the gray-failure level signal did."""
+    act = FakeActuator({1: 8, 2: 8, 3: 8}, groups=24, limping={2})
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    assert rep["triggered"]
+    assert act.balance[2] == 0, f"limping member kept {act.balance[2]}"
+    assert all(mv["reason"] == "limp_evict" for mv in rep["moves"])
+    assert {mv["frm"] for mv in rep["moves"]} == {2}
+    # Healthy survivors split the drained load; convergence is judged
+    # among THEM (they legitimately carry fair x R/(R-1) each).
+    assert act.balance[1] + act.balance[3] == 24
+    assert rep["converged"]
+
+
+def test_limping_member_never_receives():
+    """Skew pass with an (already drained) limping member: the
+    emptiest member is the LIMPING one, and without the exclusion the
+    skew path would refill the slowest member in the fleet."""
+    act = FakeActuator({1: 24, 2: 4, 3: 0}, groups=28, limping={3})
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    assert rep["moved"] > 0
+    assert all(mv["to"] != 3 for mv in rep["moves"]), rep["moves"]
+    assert act.balance[3] == 0
+
+
+def test_whole_fleet_limping_degrades_to_no_action():
+    """Every member limping: nowhere safe to move — the pass must
+    degrade to no action (and NOT report convergence while a limping
+    member still leads), never to churn between two slow members."""
+    act = FakeActuator({1: 12, 2: 12, 3: 0}, groups=24,
+                       limping={1, 2, 3})
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    assert rep["moves"] == [] and act.transfers == []
+    assert rep["triggered"]
+    assert not rep["converged"]
+
+
+def test_eviction_respects_cooldown_quarantine():
+    """A limp signal that keeps screaming must not re-move quarantined
+    groups: eviction rides the same flap-proofing as the skew path."""
+    clock = FakeClock()
+    act = FakeActuator({1: 8, 2: 8, 3: 8}, groups=24, limping={2},
+                       bounce=True)  # transfers report done, state
+    reb = Rebalancer(act, CFG, clock=clock)  # never changes
+    rep1 = reb.run_once()
+    moved_once = {mv["group"] for mv in rep1["moves"]}
+    assert moved_once
+    clock.t += 1.0
+    rep2 = reb.run_once()
+    for mv in rep2["moves"]:
+        assert mv["group"] not in moved_once, "re-moved inside cooldown"
+    assert rep2["cooldown_vetoed"] > 0
+
+
+def test_eviction_below_min_groups_still_fires():
+    """min_groups gates the SKEW heuristic (tiny clusters are never
+    'skewed'), not gray-failure eviction — a limping leader on a
+    4-group cluster is exactly as limping."""
+    act = FakeActuator({1: 4, 2: 0, 3: 0}, groups=4, limping={1})
+    reb = Rebalancer(act, CFG, clock=FakeClock())
+    rep = reb.run_once()
+    assert act.balance[1] == 0
+    assert rep["converged"]
+
+
+def test_limp_report_keys_ride_the_schema():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "rebalancerd", os.path.join(
+            os.path.dirname(__file__), "..", "..", "tools",
+            "rebalancerd.py"))
+    rbd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rbd)
+    act = FakeActuator({1: 8, 2: 8, 3: 8}, groups=24, limping={2})
+    rep = Rebalancer(act, CFG, clock=FakeClock()).run_once()
+    assert rbd.validate_report(rep) == []
+    assert rep["limping"] == [2]

@@ -1,0 +1,124 @@
+"""Rebalance under sustained faults at G=1024 (the ROADMAP item-5
+leftover, promoted by ISSUE 15 satellite 3).
+
+The PR 11 bench converged a seeded 1024-group skew fault-free; the
+fault-plane churn bar was held at G=64. This soak closes the gap: the
+same gross skew (every leadership on member 1), but the message-fault
+plane (drop/dup/delay/reorder) stays ACTIVE through the whole
+rebalance pass while a workload dribbles — transfers race lost and
+reordered MsgTimeoutNow/MsgApp traffic, exactly the regime a real
+rebalancerd runs in. Strict close: 3-checker suite +
+``invariant_trips() == 0``.
+
+Slow-marked (its G=1024 config is a fresh round-step compile — outside
+tier-1's budget); reproduce a failing seed with ETCD_TPU_CHAOS_SEED.
+"""
+
+import os
+import time
+
+import pytest
+
+from etcd_tpu.batched.faults import (
+    ChaosHarness,
+    FaultSpec,
+    LeaderObserver,
+    run_invariant_checks,
+)
+from etcd_tpu.batched.rebalance import (
+    InProcActuator,
+    RebalanceConfig,
+    Rebalancer,
+)
+from etcd_tpu.batched.state import BatchedConfig
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+G, R = 1024, 3
+SEED = int(os.environ.get("ETCD_TPU_CHAOS_SEED", "1105").split(",")[0])
+CFG = BatchedConfig(
+    num_groups=G, num_replicas=R, window=16, max_ents_per_msg=4,
+    max_props_per_round=4, election_timeout=10, heartbeat_timeout=1,
+    pre_vote=True, check_quorum=True, auto_compact=True,
+    telemetry=True, fleet_summary=True,
+)
+
+# Gentler than test_chaos.MSG_FAULTS: at G=1024 a transfer pass is
+# thousands of MsgTimeoutNow/MsgApp exchanges, and a 5% drop rate on
+# EVERY link makes convergence a coin-flip marathon rather than a
+# test. 2% drop + reorder still loses/reorders hundreds of frames
+# across the pass — sustained faults, bounded wall clock.
+SOAK_FAULTS = FaultSpec(drop=0.02, dup=0.02, delay=0.04,
+                        delay_max_s=0.02, reorder=0.1)
+
+
+def test_rebalance_converges_under_sustained_message_faults(tmp_path):
+    h = ChaosHarness(str(tmp_path), SEED, FaultSpec(), num_members=R,
+                     num_groups=G, cfg=CFG)
+    obs = LeaderObserver(h.alive)
+    try:
+        h.wait_leaders(timeout=240.0)
+        obs.start()
+        m1 = h.members[1]
+
+        # Seed the gross skew fault-free (the skew is the fixture, not
+        # the fault under test).
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            own = sum(1 for g in range(G) if m1.is_leader(g))
+            if own == G:
+                break
+            for g in range(G):
+                for m in h.members.values():
+                    if m.id != 1 and m.is_leader(g):
+                        m.transfer_leader(g, 1)
+            time.sleep(0.2)
+        assert own == G, f"seeded skew incomplete ({own}/{G})"
+
+        # Fleet frames must reflect the skew (the rebalancer's ONLY
+        # input) before the fault plane comes up.
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if m1.fleet.snapshot().get("leaders_total", 0) == G:
+                break
+            time.sleep(0.2)
+
+        # Fault plane ON for the whole rebalance pass.
+        h.plan.spec = SOAK_FAULTS
+        reb = Rebalancer(
+            InProcActuator(h.members),
+            RebalanceConfig(skew_ratio=1.5, cooldown_s=5.0,
+                            max_moves_per_pass=G, max_retries=3,
+                            transfer_wait_s=10.0, min_groups=8))
+        moved_total = 0
+        ratio_before = None
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            rep = reb.run_once()
+            if ratio_before is None:
+                ratio_before = rep["ratio_before"]
+            moved_total += rep["moved"]
+            # Sustained workload between passes: the faults keep
+            # biting real traffic, not just control messages.
+            h.run_workload(6, prefix=b"soak%d" % moved_total,
+                           per_put_timeout=20.0)
+            if rep["converged"]:
+                break
+            time.sleep(1.0)
+        assert rep["converged"], (
+            f"never converged under faults: ratio "
+            f"{ratio_before} -> {rep['ratio_after']}, "
+            f"balance {rep['balance_after']}")
+        assert moved_total > 0
+        assert ratio_before is not None and ratio_before > 1.5
+        # The fault plane must PROVE it was biting during the pass.
+        stats = h.fabric.stats()
+        assert stats.get("dropped", 0) > 0, stats
+
+        # Strict close with the faults healed.
+        h.plan.quiesce()
+        run_invariant_checks(h, obs, expect_members=R,
+                             hash_timeout=120.0, acked_timeout=60.0)
+    finally:
+        obs.stop()
+        h.stop()

@@ -6,7 +6,9 @@
 # fleet_console --once --json validated) + rebalance smoke (seeded
 # leader skew, rebalancerd --once --json must converge it) + walpipe
 # smoke (async group-commit WAL pipeline: fsync coverage > 1, clean
-# stop-drain replay) + bench-history re-emit. CI
+# stop-drain replay) + diskfault smoke (ISSUE 15 IO-error contract:
+# fsync-error fail-stop + ENOSPC back-pressure recover, zero acked
+# loss) + bench-history re-emit. CI
 # runs exactly this script
 # (.github/workflows/lint.yml); run it locally before pushing anything
 # that touches the batched hot path.
@@ -40,6 +42,9 @@ python tools/rebalance_smoke.py
 
 echo "== walpipe smoke (async group-commit WAL pipeline, fsync coverage > 1) =="
 python tools/walpipe_smoke.py
+
+echo "== diskfault smoke (fsync-error fail-stop + ENOSPC recover, IO-error contract) =="
+python tools/diskfault_smoke.py
 
 echo "== fused-round smoke (all deliver shapes agree, transfer guard disallow) =="
 python tools/fused_smoke.py

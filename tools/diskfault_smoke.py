@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Disk-fault smoke for tools/check.sh (ISSUE 15): one fsync-error
+fail-stop episode and one ENOSPC-recover episode against a tiny in-proc
+cluster, asserting the IO-error contract end to end:
+
+* a sticky injected fsync failure kills its member FAIL-STOP (crash-
+  shaped death, recorded cause, nothing released from the failed
+  window — the doomed write proposed after arming never acks), while
+  the survivor quorum keeps serving and loses zero acked writes;
+* a sticky injected ENOSPC puts its member into ``disk_full``
+  write-back-pressure (health-visible, proposals refuse, member stays
+  alive), and healing it recovers in place — zero acked writes lost,
+  no crash-loop;
+* the ``etcd_tpu_disk_fault_*`` metric families actually move.
+
+One tiny compile (~seconds on CPU); a contract regression fails the
+static gate, not a hosted run. Writes artifacts/diskfault_smoke.json
+(uploaded by lint.yml on failure).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from etcd_tpu.batched.faults import DiskFaultPlan  # noqa: E402
+from etcd_tpu.batched.hosting import MultiRaftCluster  # noqa: E402
+from etcd_tpu.batched.state import BatchedConfig  # noqa: E402
+from etcd_tpu.pkg import metrics as pmet  # noqa: E402
+
+G, R = 4, 3
+OUT = os.path.join("artifacts", "diskfault_smoke.json")
+
+
+def _write(report) -> None:
+    os.makedirs("artifacts", exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+
+
+def _fail(report, msg: str) -> int:
+    report["ok"] = False
+    report["error"] = msg
+    _write(report)
+    print(f"diskfault smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def _wait(pred, timeout: float) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def main() -> int:
+    cfg = BatchedConfig(
+        num_groups=G, num_replicas=R, window=8, max_ents_per_msg=2,
+        max_props_per_round=2, election_timeout=10,
+        heartbeat_timeout=1, pre_vote=True, check_quorum=True,
+        auto_compact=True,
+    )
+    report = {"groups": G, "members": R, "ok": False}
+    acked = {}  # (g, key) -> value; every entry must survive
+
+    def put_all(c, tag: str, n: int = 2) -> None:
+        for g in range(G):
+            for i in range(n):
+                k, v = b"%s-k%d" % (tag.encode(), i), b"%s-g%d-v%d" % (
+                    tag.encode(), g, i)
+                c.put(g, k, v, timeout=60.0)
+                acked[(g, k)] = v
+
+    def survivors_hold_everything(members) -> bool:
+        return all(m.get(g, k) == v for m in members
+                   for (g, k), v in acked.items())
+
+    # -- episode 1: fsync error => fail-stop -----------------------------------
+    plan = DiskFaultPlan(seed=15)
+    data_dir = tempfile.mkdtemp(prefix="diskfault-smoke-")
+    c = MultiRaftCluster(data_dir, num_members=R, num_groups=G,
+                         cfg=cfg, disk_fault_hook_fn=plan.hook_for)
+    try:
+        c.wait_leaders(timeout=120.0)
+        put_all(c, "pre")
+        victim = c.members[2]
+        plan.arm_fsync_error(2, sticky=True)
+        # The doomed write: proposed at the victim (if it leads
+        # anything) AFTER arming — it must never ack.
+        doomed_g = next((g for g in range(G)
+                         if victim.is_leader(g)), None)
+        if doomed_g is not None:
+            victim.propose(doomed_g, b"P" + b"doomed\x00never")
+        if not _wait(lambda: victim._stopped.is_set(), 30.0):
+            # No organic fsync traffic: force some via the survivors.
+            put_all(c, "nudge", n=1)
+            if not _wait(lambda: victim._stopped.is_set(), 30.0):
+                return _fail(report, "victim never fail-stopped")
+        hl = victim.health()
+        report["failstop"] = {
+            "cause": hl["fail_stop"], "crashed": hl["crashed"],
+            "injected": plan.stats(),
+        }
+        if not (hl["crashed"] and hl["fail_stop"]
+                and hl["fail_stop"].startswith("fsync:")):
+            return _fail(report, f"not a fail-stop death: {hl}")
+        if doomed_g is not None and victim.get(
+                doomed_g, b"doomed") is not None:
+            return _fail(report,
+                         "apply released from the failed fsync window")
+        survivors = [m for m in c.members.values() if m.id != 2]
+        put_all(c, "post")  # quorum keeps serving
+        if not _wait(lambda: survivors_hold_everything(survivors),
+                     60.0):
+            return _fail(report, "acked writes lost after fail-stop")
+    finally:
+        c.stop()
+
+    # -- episode 2: ENOSPC => back-pressure, heal => recover -------------------
+    plan2 = DiskFaultPlan(seed=16)
+    data_dir2 = tempfile.mkdtemp(prefix="diskfault-smoke-enospc-")
+    acked.clear()
+    c2 = MultiRaftCluster(data_dir2, num_members=R, num_groups=G,
+                          cfg=cfg, disk_fault_hook_fn=plan2.hook_for)
+    try:
+        c2.wait_leaders(timeout=120.0)
+        put_all(c2, "pre")
+        m1 = c2.members[1]
+        plan2.arm_enospc(1)
+
+        def nudge_writes():
+            # The hook fires at the WAL seam, so the member must have
+            # append traffic to notice the full disk; untracked dummy
+            # proposals at every member provide it (the one landing on
+            # an m1-led group stalls un-acked behind the dwell, which
+            # is the contract).
+            for g in range(G):
+                for m in c2.members.values():
+                    m.propose(g, b"P" + b"nudge\x001")
+
+        if not _wait(lambda: (nudge_writes()
+                              or m1.health()["disk_full"]), 30.0):
+            return _fail(report, "member never entered disk_full")
+        if m1.propose(0, b"P" + b"x\x00y"):
+            return _fail(report, "disk_full member accepted a proposal")
+        put_all(c2, "mid", n=1)  # quorum serves around the stall
+        plan2.heal_enospc(1)
+        if not _wait(lambda: not m1.health()["disk_full"], 30.0):
+            return _fail(report, "member never left disk_full")
+        if m1._stopped.is_set():
+            return _fail(report, "ENOSPC crash-looped the member")
+        put_all(c2, "post", n=1)
+        if not _wait(lambda: survivors_hold_everything(
+                c2.members.values()), 60.0):
+            return _fail(report, "acked writes lost across ENOSPC")
+        report["enospc"] = {
+            "injected": plan2.stats(),
+            "waits": m1.health()["disk_full_waits"],
+        }
+        if report["enospc"]["waits"] < 1:
+            return _fail(report, "back-pressure dwell never ran")
+    finally:
+        c2.stop()
+
+    text = pmet.DEFAULT.expose()
+    missing = [f for f in (
+        "etcd_tpu_disk_fault_failstop_total",
+        "etcd_tpu_disk_fault_disk_full",
+        "etcd_tpu_disk_fault_injected_total",
+    ) if f not in text]
+    if missing:
+        return _fail(report, f"metric families missing: {missing}")
+
+    report["ok"] = True
+    _write(report)
+    print(f"diskfault smoke OK: fail-stop cause "
+          f"{report['failstop']['cause']!r}, ENOSPC recovered after "
+          f"{report['enospc']['waits']} dwells, zero acked loss "
+          f"({OUT})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,6 +118,14 @@ def collect(addrs: List[str], timeout: float = 10.0,
             # {"enabled": False, ...} when it runs inline persistence.
             "wal_pipeline": (hl.get("wal_pipeline")
                              if hl.get("ok") else None),
+            # Storage fault plane (ISSUE 15): live ENOSPC back-pressure
+            # + fail-stop cause from the health op, gray-failure limp
+            # state from the fleet rollup.
+            "disk_full": (hl.get("disk_full", False)
+                          if hl.get("ok") else None),
+            "fail_stop": (hl.get("fail_stop")
+                          if hl.get("ok") else None),
+            "limp": roll.get("limp") or {},
             "router_loss": (_sum_numeric(st.get("router", {}))
                             if st.get("ok") else None),
         })
@@ -156,6 +164,15 @@ def collect(addrs: List[str], timeout: float = 10.0,
                                   else None),
         "router_loss_total": sum(m["router_loss"] or 0 for m in live),
         "lag_max": max((m["lag_max"] for m in live), default=0),
+        # Storage fault plane rollup (ISSUE 15): members currently in
+        # ENOSPC back-pressure / limping / dead by fail-stop.
+        "disk_full_members": sorted(
+            m["member"] for m in live if m.get("disk_full")),
+        "limping_members": sorted(
+            m["member"] for m in live
+            if (m.get("limp") or {}).get("limping")),
+        "failstop_members": sorted(
+            m["member"] for m in live if m.get("fail_stop")),
         "top": merged_top,
         "anomalies": anomalies,
     }
@@ -208,8 +225,8 @@ def render(data: Dict, top: int = 8) -> str:
         "",
         f"{'member':>8} {'frames':>8} {'leaders':>8} {'fenced':>7} "
         f"{'joint':>6} {'lrnr':>5} "
-        f"{'lag max':>8} {'inv':>5} {'loss':>6} {'r/fsync':>8}  "
-        f"wal tail / state",
+        f"{'lag max':>8} {'inv':>5} {'loss':>6} {'r/fsync':>8} "
+        f"{'fsync ms':>9}  wal tail / disk state",
     ]
     for mid in sorted(data["members"]):
         m = data["members"][mid]
@@ -219,12 +236,25 @@ def render(data: Dict, top: int = 8) -> str:
         wp = m.get("wal_pipeline") or {}
         rpf = (f"{wp.get('rounds_per_fsync', 0):.1f}"
                if wp.get("enabled") else "-")
+        limp = m.get("limp") or {}
+        ewma = limp.get("fsync_ewma_ms")
+        fsync_ms = f"{ewma:.1f}" if ewma is not None else "-"
+        # The disk-state tail: wal tail classification, plus any live
+        # fault-plane condition (limping / disk_full / fail-stop).
+        disk = str(m["wal_tail"])
+        if limp.get("limping"):
+            disk += " LIMPING"
+        if m.get("disk_full"):
+            disk += " DISK_FULL"
+        if m.get("fail_stop"):
+            disk += f" FAILSTOP({m['fail_stop']})"
         lines.append(
             f"{m['member']:>8} {m['frames']:>8} {m['leaders']:>8} "
             f"{m['fenced']:>7} {str(m.get('joint')):>6} "
             f"{str(m.get('learners')):>5} {m['lag_max']:>8} "
             f"{str(m['invariant_trips']):>5} "
-            f"{str(m['router_loss']):>6} {rpf:>8}  {m['wal_tail']}")
+            f"{str(m['router_loss']):>6} {rpf:>8} {fsync_ms:>9}  "
+            f"{disk}")
     lines.append("")
     lines.append(f"top-{top} laggards (cluster-wide):")
     if cl["top"]:
