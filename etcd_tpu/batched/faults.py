@@ -528,12 +528,14 @@ class LeaderObserver(threading.Thread):
 
 
 class ChaosHarness:
-    """R members × G groups with a seeded fault plane, over either the
-    in-proc router (``transport='inproc'``) or real TCP sockets
-    (``transport='tcp'``); supports scripted crash/restart cycles
+    """R members × G groups with a seeded fault plane, over the
+    in-proc router (``transport='inproc'``), real TCP sockets
+    (``transport='tcp'``), or the mmap'd shm ring fabric
+    (``transport='shm'``); supports scripted crash/restart cycles
     (through ``_replay``), storage-failpoint crashes, torn-tail WAL
     injection, and an acked-write ledger for the committed-never-lost
-    checker."""
+    checker. One FaultyFabric drives all three transports through the
+    same ``member._send``/``_send_block`` seam."""
 
     def __init__(self, data_dir: str, seed: int,
                  spec: Optional[FaultSpec] = None,
@@ -546,7 +548,7 @@ class ChaosHarness:
                  trace: bool = False,
                  wal_pipeline: bool = False,
                  wal_group_max_delay: Optional[float] = None) -> None:
-        assert transport in ("inproc", "tcp"), transport
+        assert transport in ("inproc", "tcp", "shm"), transport
         self.data_dir = data_dir
         self.seed = seed
         self.r = num_members
@@ -608,8 +610,11 @@ class ChaosHarness:
         # delay heap can never leak into the re-added successor.
         self._inc_tokens: Dict[int, object] = {}
         self._removed: set = set()
-        self.routers: Dict[int, TCPRouter] = {}
+        # member id -> per-member fabric (TCPRouter or ShmFabric),
+        # popped + stopped on crash; inproc members share one router.
+        self.routers: Dict[int, object] = {}
         self._ports: Dict[int, int] = {}  # stable rebind port per member
+        self._shm_dir = os.path.join(data_dir, "shmfabric")
         self.inproc: Optional[InProcRouter] = (
             InProcRouter() if transport == "inproc" else None
         )
@@ -646,6 +651,18 @@ class ChaosHarness:
         )
         if self.inproc is not None:
             self.inproc.attach(m)
+        elif self.transport == "shm":
+            from .shmfabric import ShmFabric
+
+            # A restart reopens the SAME lane ring files: the writer
+            # side resumes after its crashed incarnation's last
+            # published frame, the reader side resyncs (stale frames
+            # counted, never delivered) — see shmfabric.ShmRing.
+            router = ShmFabric(m, self._shm_dir)
+            for other, r2 in self.routers.items():
+                router.add_peer(other)
+                r2.add_peer(mid)
+            self.routers[mid] = router
         else:
             deadline = time.monotonic() + 10.0
             while True:

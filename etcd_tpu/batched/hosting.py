@@ -2273,6 +2273,8 @@ class InProcRouter:
     queues preserve per-peer ordering (rafthttp's stream semantics)
     without blocking the sender's round loop."""
 
+    kind = "inproc"
+
     def __init__(self) -> None:
         self.members: Dict[int, MultiRaftMember] = {}
         self._isolated: set = set()
@@ -2387,6 +2389,7 @@ class TCPRouter:
     exactly like InProcRouter; senders drop-don't-block (ref:
     etcdserver/raft.go:108-111)."""
 
+    kind = "tcp"
     MAX_PENDING = 16384
     BLOCK_SENTINEL = 0xFFFFFFFF  # group-id marker for SoA block frames
     # Sender redial policy: bounded exponential backoff with ±50%
@@ -2446,6 +2449,15 @@ class TCPRouter:
     def add_peer(self, peer_id: int, addr: Tuple[str, int]) -> None:
         with self._lock:
             self._addrs[peer_id] = addr
+
+    @staticmethod
+    def _frame(group_or_sentinel: int, body: bytes) -> bytes:
+        """The wire frame: u4 total (group word + body) | u4 group or
+        BLOCK_SENTINEL | body. The one place the header layout is
+        packed — the shm fabric reuses the body layout (group word +
+        payload) without the length prefix."""
+        return struct.pack(
+            "<II", len(body) + 4, group_or_sentinel) + body
 
     def _count(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
@@ -2531,8 +2543,7 @@ class TCPRouter:
                 # single unsendable record: drop (raft retries)
                 self._count("oversize_drop")
                 return
-            frame = struct.pack(
-                "<II", len(body) + 4, self.BLOCK_SENTINEL) + body
+            frame = self._frame(self.BLOCK_SENTINEL, body)
             try:
                 q2.put_nowait((prio, next(self._seq), frame))
             except _q.Full:  # drop, never block the round loop
@@ -2609,9 +2620,7 @@ class TCPRouter:
                     # retries via snapshots).
                     self._count("oversize_drop")
                     continue
-                frame = (
-                    struct.pack("<II", len(payload) + 4, group) + payload
-                )
+                frame = self._frame(group, payload)
             deadline = time.monotonic() + self.REDIAL_BUDGET
             while not self._stopped.is_set():
                 if sock is None:
@@ -2707,17 +2716,30 @@ class TCPRouter:
             ).start()
 
     def _recv_loop(self, conn) -> None:
-        def read_exact(n: int) -> Optional[bytes]:
-            buf = b""
-            while len(buf) < n:
+        # Frames are read straight into one preallocated buffer with
+        # recv_into (grown on demand up to the frame cap) — a frame
+        # costs ONE owned copy-out at the end instead of O(chunks)
+        # bytes concatenations per frame. The copy-out is not
+        # removable: deliver_block defers the block to the next round,
+        # so handing it a view into a reused buffer would corrupt it
+        # under the queue.
+        buf = bytearray(64 * 1024)
+
+        def read_exact(n: int) -> Optional[memoryview]:
+            nonlocal buf
+            if n > len(buf):
+                buf = bytearray(n)
+            mv = memoryview(buf)
+            got = 0
+            while got < n:
                 try:
-                    chunk = conn.recv(n - len(buf))
+                    k = conn.recv_into(mv[got:n])
                 except OSError:
                     return None
-                if not chunk:
+                if not k:
                     return None
-                buf += chunk
-            return buf
+                got += k
+            return mv[:n]
 
         while not self._stopped.is_set():
             hdr = read_exact(4)
@@ -2735,7 +2757,7 @@ class TCPRouter:
                 from .msgblock import MsgBlock
 
                 try:
-                    blk = MsgBlock.from_bytes(body[4:])
+                    blk = MsgBlock.from_bytes(bytes(body[4:]))
                 except ValueError:  # corrupt frame: drop conn
                     self._count("recv_corrupt")
                     break
@@ -2745,7 +2767,7 @@ class TCPRouter:
                     self._count("deliver_error")
                 continue
             try:
-                m = self._dec(body[4:])
+                m = self._dec(bytes(body[4:]))
             except Exception:  # noqa: BLE001 — corrupt frame: drop conn
                 self._count("recv_corrupt")
                 break

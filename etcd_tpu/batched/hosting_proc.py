@@ -208,8 +208,14 @@ class AdminServer:
             rs = getattr(self.router, "stats", None)
             if callable(rs):
                 rstats = rs()
+            # Fabric identity + per-lane ring occupancy (shm only):
+            # fleet_console's transport column reads this.
+            fabric = {"kind": getattr(self.router, "kind", "tcp")}
+            ls = getattr(self.router, "lane_stats", None)
+            if callable(ls):
+                fabric["lanes"] = ls()
             return {"ok": True, "member": dict(m.stats),
-                    "router": rstats}
+                    "router": rstats, "fabric": fabric}
         if op == "health":
             # Durability-fence visibility (protocol-aware torn-tail
             # recovery): per-group fenced state, the index gap still to
@@ -414,9 +420,30 @@ def serve(member_id: int, num_members: int, num_groups: int,
           telemetry: bool = False,
           fleet: bool = False,
           trace: Optional[bool] = None,
-          wal_pipeline: Optional[bool] = None) -> None:
+          wal_pipeline: Optional[bool] = None,
+          fabric: str = "tcp",
+          shm_dir: Optional[str] = None,
+          pin_core: Optional[int] = None) -> None:
     from .hosting import MultiRaftMember
     from .state import BatchedConfig
+
+    if fabric == "inproc":
+        raise SystemExit(
+            "--fabric=inproc is the single-process harness fabric "
+            "(MultiRaftCluster / tools/fleet_smoke.py); a hosting_proc "
+            "worker is its own OS process — use tcp or shm")
+    if fabric == "shm" and not shm_dir:
+        raise SystemExit("--fabric=shm requires --shm-dir (one "
+                         "directory SHARED by all member processes)")
+    if pin_core is not None:
+        # One pinned core per member process (true multi-core runs):
+        # the shm fabric's whole point is that co-hosted members stop
+        # time-slicing one socket loop.
+        try:
+            os.sched_setaffinity(0, {pin_core})
+        except (AttributeError, OSError) as e:
+            print(f"member {member_id}: pin to core {pin_core} "
+                  f"failed: {e}", flush=True)
 
     cfg = BatchedConfig(
         num_groups=num_groups,
@@ -444,14 +471,23 @@ def serve(member_id: int, num_members: int, num_groups: int,
         # round cadence, acks released on fsync completion.
         wal_pipeline=wal_pipeline,
     )
-    from .hosting import TCPRouter
+    if fabric == "shm":
+        from .shmfabric import ShmFabric
 
-    router = TCPRouter(member, bind=bind)
-    for pid, addr in peers.items():
-        router.add_peer(pid, addr)
+        router = ShmFabric(member, shm_dir)
+        for pid in peers:
+            router.add_peer(pid)
+        raft_ep = f"shm:{shm_dir}"
+    else:
+        from .hosting import TCPRouter
+
+        router = TCPRouter(member, bind=bind)
+        for pid, addr in peers.items():
+            router.add_peer(pid, addr)
+        raft_ep = router.addr
     srv = AdminServer(member, router, admin)
     member.start()
-    print(f"member {member_id} serving: raft={router.addr} "
+    print(f"member {member_id} serving: raft={raft_ep} "
           f"admin={srv.addr} groups={num_groups}", flush=True)
     threading.Event().wait()  # park; admin 'stop' hard-exits
 
@@ -488,6 +524,21 @@ def main(argv: Optional[List[str]] = None) -> None:
                         "last, acks released at fsync completion "
                         "(ETCD_TPU_WAL_PIPELINE=1 is the env form; "
                         "admin 'health' reports rounds_per_fsync)")
+    p.add_argument("--fabric", choices=("tcp", "shm", "inproc"),
+                   default="tcp",
+                   help="peer transport: tcp (TCPRouter sockets, "
+                        "default), shm (mmap'd SPSC ring fabric for "
+                        "co-hosted members — requires --shm-dir), "
+                        "inproc (single-process harness only; a "
+                        "worker process rejects it with a pointer)")
+    p.add_argument("--shm-dir", default=None,
+                   help="directory for the shm fabric's lane ring "
+                        "files; must be the SAME directory for every "
+                        "member process of the cluster")
+    p.add_argument("--pin-core", type=int, default=None,
+                   help="pin this member process to one CPU core "
+                        "(sched_setaffinity) — one core per member "
+                        "is the multi-core hosted-bench shape")
     a = p.parse_args(argv)
 
     def hp(s: str) -> Tuple[str, int]:
@@ -502,7 +553,8 @@ def main(argv: Optional[List[str]] = None) -> None:
           hp(a.admin), peers, window=a.window,
           tick_interval=a.tick_interval, telemetry=a.telemetry,
           fleet=a.fleet, trace=a.trace or None,
-          wal_pipeline=a.wal_pipeline or None)
+          wal_pipeline=a.wal_pipeline or None,
+          fabric=a.fabric, shm_dir=a.shm_dir, pin_core=a.pin_core)
 
 
 # -- client side ---------------------------------------------------------------

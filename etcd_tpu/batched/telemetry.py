@@ -337,13 +337,74 @@ def trace_drop_counter(
 
 def router_loss_counter(
         registry: Optional[pmet.Registry] = None) -> pmet.Counter:
-    """One source of truth for transport drop classes (InProcRouter and
-    TCPRouter both count here; their stats() ops read back from it)."""
+    """One source of truth for transport drop classes (InProcRouter,
+    TCPRouter and ShmFabric all count here; their stats() ops read
+    back from it)."""
     reg = registry or pmet.DEFAULT
     return reg.register(pmet.Counter(
         "etcd_tpu_router_loss_total",
         "messages lost or errored by the member fabric, by drop class",
         ("transport", "member", "cls"),
+    ))
+
+
+# Shared-memory ring fabric families (ISSUE 16, batched/shmfabric.py):
+# per outbound lane (member -> peer, live|bulk ring). Losses count on
+# router_loss_counter (transport="shm") like every fabric; these
+# families carry the ring-occupancy/throughput shape the fleet
+# console's transport column and capacity tuning read.
+
+
+def shm_ring_depth_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_shm_ring_bytes",
+        "shm fabric ring occupancy (unread bytes) per outbound lane",
+        ("member", "peer", "ring"),
+    ))
+
+
+def shm_ring_high_water_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_shm_ring_high_water_bytes",
+        "shm fabric ring occupancy high-water mark per outbound lane",
+        ("member", "peer", "ring"),
+    ))
+
+
+def shm_frames_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_shm_frames_total",
+        "frames written into shm fabric rings per outbound lane",
+        ("member", "peer", "ring"),
+    ))
+
+
+def shm_copy_bytes_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_shm_copy_bytes_total",
+        "frame body bytes copied into shm fabric rings per outbound "
+        "lane (the transport's entire copy cost)",
+        ("member", "peer", "ring"),
+    ))
+
+
+def shm_ring_full_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_shm_ring_full_total",
+        "shm ring-full events per outbound lane (each drops one frame "
+        "drop-don't-block; records counted on "
+        "etcd_tpu_router_loss_total cls=ring_full_drop)",
+        ("member", "peer", "ring"),
     ))
 
 

@@ -151,6 +151,14 @@ def dump_local(names_only: bool = False) -> int:
     btel.disk_full_gauge()
     btel.disk_fault_injected_counter()
     btel.disk_fault_salvage_counter()
+    # Shm ring fabric families (ISSUE 16): per-lane ring occupancy,
+    # high-water, frames/copied-bytes throughput and ring-full events
+    # (record losses stay on etcd_tpu_router_loss_total).
+    btel.shm_ring_depth_gauge()
+    btel.shm_ring_high_water_gauge()
+    btel.shm_frames_counter()
+    btel.shm_copy_bytes_counter()
+    btel.shm_ring_full_counter()
     # Fleet observatory families (ISSUE 10): histograms + censuses +
     # anomaly counters fed from the device SummaryFrame; --watch picks
     # their deltas up like any other series once a member moves them.

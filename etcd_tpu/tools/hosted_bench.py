@@ -1,6 +1,9 @@
-"""Hosted-path benchmark: 3 real OS processes, TCPRouter over real
-sockets, G groups on CPU — the service-rate number next to bench.py's
-kernel rate (VERDICT r04 task #1: a per-round artifact with a floor).
+"""Hosted-path benchmark: 3 real OS processes over a selectable peer
+fabric (``--fabric=tcp`` sockets or ``--fabric=shm`` mmap'd SPSC
+rings, ISSUE 16), G groups on CPU — the service-rate number next to
+bench.py's kernel rate (VERDICT r04 task #1: an artifact with a
+floor). ``--pin-cores`` pins member i to core (i-1) mod ncpu, the
+one-core-per-member multi-core shape.
 
 Writes HOSTED_BENCH.json at the repo root:
 
@@ -61,7 +64,8 @@ def free_ports(n):
 
 
 def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0,
-          trace=0, wal_pipeline=False):
+          trace=0, wal_pipeline=False, fabric="tcp", shm_dir=None,
+          pin_cores=False):
     peers = [
         f"--peer={pid}=127.0.0.1:{raft_ports[pid]}"
         for pid in range(1, MEMBERS + 1) if pid != mid
@@ -92,7 +96,16 @@ def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0,
             "--admin", f"127.0.0.1:{admin_ports[mid]}",
             "--tick-interval", "0.1",
         ] + (["--trace"] if trace else [])
-        + (["--wal-pipeline"] if wal_pipeline else []) + peers,
+        + (["--wal-pipeline"] if wal_pipeline else [])
+        + (["--fabric", fabric] if fabric != "tcp" else [])
+        + (["--shm-dir", shm_dir] if fabric == "shm" else [])
+        # One pinned core per member: member i on core (i-1) mod ncpu.
+        # On a 1-core box every member pins to core 0 (the status quo
+        # made explicit); on a real multi-core box this is the shape
+        # the shm fabric's headline targets assume.
+        + (["--pin-core", str((mid - 1) % (os.cpu_count() or 1))]
+           if pin_cores else [])
+        + peers,
         env=env, stdout=log, stderr=subprocess.STDOUT,
     )
 
@@ -121,6 +134,17 @@ def main() -> None:
                          "WAL pipeline (ISSUE 13); also honored via "
                          "ETCD_TPU_WAL_PIPELINE=1 — A/B rows against "
                          "the inline baseline land in BENCH_NOTES")
+    ap.add_argument("--fabric", choices=("tcp", "shm"), default="tcp",
+                    help="peer transport for the workers: tcp "
+                         "(TCPRouter sockets, default) or shm (the "
+                         "mmap'd SPSC ring fabric, ISSUE 16); "
+                         "artifacts are labeled with the choice")
+    ap.add_argument("--shm-dir", default=None,
+                    help="shared lane-ring directory for --fabric=shm "
+                         "(default: <data-dir>/shmfabric)")
+    ap.add_argument("--pin-cores", action="store_true",
+                    help="pin member i to core (i-1) mod ncpu — the "
+                         "one-core-per-member multi-core shape")
     args = ap.parse_args()
     # Slow-disk emulation label (native/walog.py): a bench flown with
     # ETCD_TPU_FSYNC_DELAY_MS set must say so in its artifact config.
@@ -130,6 +154,7 @@ def main() -> None:
     import tempfile
 
     data_dir = args.data_dir or tempfile.mkdtemp(prefix="hosted-bench-")
+    shm_dir = args.shm_dir or os.path.join(data_dir, "shmfabric")
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     out_path = args.out or os.path.join(repo, "HOSTED_BENCH.json")
@@ -141,7 +166,9 @@ def main() -> None:
         for mid in range(1, MEMBERS + 1):
             procs[mid] = spawn(mid, raft_p, admin_p, data_dir,
                                args.groups, trace=args.trace,
-                               wal_pipeline=args.wal_pipeline)
+                               wal_pipeline=args.wal_pipeline,
+                               fabric=args.fabric, shm_dir=shm_dir,
+                               pin_cores=args.pin_cores)
         for mid in range(1, MEMBERS + 1):
             clients[mid] = wait_admin(("127.0.0.1", admin_p[mid]),
                                       timeout=300.0)
@@ -293,6 +320,7 @@ def main() -> None:
                 slo["config"] = (f"G={args.groups} R={MEMBERS} "
                                  f"value={args.value_size}B "
                                  f"inflight={args.inflight}/group CPU "
+                                 f"fabric={args.fabric} "
                                  f"trace=1/{args.trace}"
                                  + (" walpipe=on" if args.wal_pipeline
                                     else "") + delay_tag)
@@ -311,7 +339,9 @@ def main() -> None:
         t0 = time.monotonic()
         procs[3] = spawn(3, raft_p, admin_p, data_dir, args.groups,
                          gen=1, trace=args.trace,
-                         wal_pipeline=args.wal_pipeline)
+                         wal_pipeline=args.wal_pipeline,
+                         fabric=args.fabric, shm_dir=shm_dir,
+                         pin_cores=args.pin_cores)
         clients[3] = wait_admin(("127.0.0.1", admin_p[3]), timeout=300.0)
         while time.monotonic() - t0 < 180.0:
             if clients[3].get(g, b"catchup") == b"1":
@@ -330,10 +360,13 @@ def main() -> None:
             "lost": bench.get("lost", 0),
             "groups_led": bench["groups"],
             "phase_ms_per_round": phase_ms,
+            "fabric": args.fabric,
             "restart_catchup_s": round(catchup_s, 1),
             "config": (f"G={args.groups} R={MEMBERS} procs={MEMBERS} "
                        f"value={args.value_size}B "
-                       f"inflight={args.inflight}/group CPU"
+                       f"inflight={args.inflight}/group CPU "
+                       f"fabric={args.fabric}"
+                       + (" pinned" if args.pin_cores else "")
                        + (f" trace=1/{args.trace}" if args.trace
                           else "")
                        + (" walpipe=on" if args.wal_pipeline else "")

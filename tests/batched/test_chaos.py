@@ -80,6 +80,10 @@ class TestMessageFaults:
         ("inproc", SEEDS[0]),
         ("inproc", SEEDS[-1]),
         ("tcp", SEEDS[0]),
+        # The shm ring fabric (ISSUE 16) rides the same CFG — zero
+        # new round-step compiles; the 2×2 shm soak matrix lives in
+        # test_chaos_soak.py.
+        ("shm", SEEDS[0]),
     ])
     def test_faulty_links_converge(self, tmp_path, transport, seed):
         h = make_harness(tmp_path, seed, MSG_FAULTS, transport)

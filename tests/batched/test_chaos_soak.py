@@ -298,3 +298,92 @@ class TestConfChurnMatrix:
         finally:
             obs.stop()
             h.stop()
+
+
+# -- shm ring-fabric cells (ISSUE 16) ------------------------------------------
+#
+# The mmap'd SPSC ring fabric under the two heaviest fault classes ×
+# both WAL modes (inline and the async group-commit pipeline), closed
+# at the same strict bar as the base matrix: all three checkers +
+# invariant_trips()==0. Reuses the module CFG — zero new round-step
+# compiles (wal_pipeline is a member flag, not a config field). The
+# cells prove the restart semantics the fabric documents: frames sent
+# to a crashed peer fill its rings and count (ring_full_drop), a
+# restarted reader resyncs its predecessor's backlog (stale_drop) —
+# loss is counted, never silent.
+
+
+@pytest.mark.parametrize("wal_pipeline", [False, True],
+                         ids=["inline", "walpipe"])
+class TestShmFabricMatrix:
+    def test_shm_message_faults_with_partitions(self, tmp_path,
+                                                wal_pipeline):
+        """Lossy links + a symmetric isolation episode over the shm
+        rings (FaultyFabric interposes through the same _send_block
+        seam as the other two transports)."""
+        seed = SEEDS[0]
+        h = ChaosHarness(str(tmp_path), seed, SOAK_FAULTS,
+                         num_members=R, num_groups=G, cfg=CFG,
+                         transport="shm", wal_pipeline=wal_pipeline)
+        obs = LeaderObserver(h.alive)
+        try:
+            h.wait_leaders()
+            obs.start()
+            h.run_workload(30, prefix=b"a")
+            victim = h.plan.derived_rng("victim").randrange(R) + 1
+            h.plan.isolate_member(victim, h.members.keys())
+            h.run_workload(20, prefix=b"b", per_put_timeout=15.0)
+            h.plan.heal_all()
+            h.run_workload(10, prefix=b"c")
+            h.plan.quiesce()
+            full_check(h, obs)
+            assert h.fabric.stats().get("dropped", 0) > 0
+            assert h.fabric.stats().get("partitioned", 0) > 0
+            # Frames really rode the rings (both priority classes).
+            lanes = {f"{mid}/{k}": v
+                     for mid, r in h.routers.items()
+                     for k, v in r.lane_stats().items()}
+            assert sum(v["frames"] for k, v in lanes.items()
+                       if k.endswith(":live")) > 0
+            assert sum(v["frames"] for k, v in lanes.items()
+                       if k.endswith(":bulk")) > 0
+        finally:
+            obs.stop()
+            h.stop()
+
+    def test_shm_crash_restart_cycles(self, tmp_path, wal_pipeline):
+        """Two kill/restart cycles through _replay over the rings: the
+        reborn member's fabric reopens the SAME lane files, resumes
+        write positions, and resyncs (counted, never delivered) any
+        backlog addressed to its dead incarnation."""
+        seed = SEEDS[0]
+        h = ChaosHarness(str(tmp_path), seed,
+                         FaultSpec(drop=0.03, delay=0.05,
+                                   delay_max_s=0.03),
+                         num_members=R, num_groups=G, cfg=CFG,
+                         transport="shm", wal_pipeline=wal_pipeline)
+        obs = LeaderObserver(h.alive)
+        try:
+            h.wait_leaders()
+            obs.start()
+            h.run_workload(15, prefix=b"pre")
+            rng = h.plan.derived_rng("crash")
+            for cycle, site in enumerate(("before_save", "after_save")):
+                victim = rng.randrange(R) + 1
+                h.crash_on_failpoint(victim, site)
+                acked = h.run_workload(10, prefix=b"mid%d" % cycle,
+                                       per_put_timeout=15.0)
+                assert acked >= 5
+                h.restart(victim)
+                h.wait_leaders()
+            h.run_workload(8, prefix=b"post")
+            h.plan.quiesce()
+            full_check(h, obs)
+            # Any loss across the crash windows is COUNTED on the
+            # shared registry (stale_drop / ring_full_drop / no_route),
+            # and stats() answers on every live fabric.
+            for r in h.routers.values():
+                assert isinstance(r.stats(), dict)
+        finally:
+            obs.stop()
+            h.stop()

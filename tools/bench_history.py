@@ -99,12 +99,17 @@ def collect(repo: str) -> List[Dict]:
         d = _load(path) if os.path.exists(path) else None
         if not d or "puts_per_sec" not in d:
             continue
+        extra = {"p50_ms": d.get("p50_ms"), "p99_ms": d.get("p99_ms"),
+                 "lost": d.get("lost"),
+                 "restart_catchup_s": d.get("restart_catchup_s")}
+        # Transport provenance (ISSUE 16): hosted_shm_* rows carry the
+        # fabric explicitly; older artifacts are implicitly tcp.
+        if d.get("fabric"):
+            extra["fabric"] = d["fabric"]
         add("hosted", path, d["puts_per_sec"], "puts/s",
             config=d.get("config", ""),
             captured_at=d.get("captured_at", ""),
-            extra={"p50_ms": d.get("p50_ms"), "p99_ms": d.get("p99_ms"),
-                   "lost": d.get("lost"),
-                   "restart_catchup_s": d.get("restart_catchup_s")})
+            extra=extra)
 
     # Multi-chip dry-runs: ok/skip status per round (plus hosted-shape
     # numbers when the round captured them).

@@ -8,7 +8,9 @@
 # smoke (async group-commit WAL pipeline: fsync coverage > 1, clean
 # stop-drain replay) + diskfault smoke (ISSUE 15 IO-error contract:
 # fsync-error fail-stop + ENOSPC back-pressure recover, zero acked
-# loss) + bench-history re-emit. CI
+# loss) + shmfabric smoke (ISSUE 16 mmap ring transport: 3-member shm
+# cluster, put wave, console transport column + shm metric families)
+# + bench-history re-emit. CI
 # runs exactly this script
 # (.github/workflows/lint.yml); run it locally before pushing anything
 # that touches the batched hot path.
@@ -48,6 +50,9 @@ python tools/diskfault_smoke.py
 
 echo "== fused-round smoke (all deliver shapes agree, transfer guard disallow) =="
 python tools/fused_smoke.py
+
+echo "== shmfabric smoke (3-member shm ring cluster, console transport column) =="
+python tools/shmfabric_smoke.py
 
 echo "== bench history (artifacts/bench_history.json + BENCH_HISTORY.md) =="
 python tools/bench_history.py

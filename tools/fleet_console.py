@@ -128,6 +128,13 @@ def collect(addrs: List[str], timeout: float = 10.0,
             "limp": roll.get("limp") or {},
             "router_loss": (_sum_numeric(st.get("router", {}))
                             if st.get("ok") else None),
+            # Transport plane (ISSUE 16): fabric kind from the stats
+            # op, plus the shm fabric's per-lane ring depth/high-water
+            # (absent on tcp/inproc — their backlog lives in queues).
+            "fabric": ((st.get("fabric") or {}).get("kind")
+                       if st.get("ok") else None),
+            "fabric_lanes": ((st.get("fabric") or {}).get("lanes")
+                             if st.get("ok") else None),
         })
         members[mid] = ent
 
@@ -226,7 +233,7 @@ def render(data: Dict, top: int = 8) -> str:
         f"{'member':>8} {'frames':>8} {'leaders':>8} {'fenced':>7} "
         f"{'joint':>6} {'lrnr':>5} "
         f"{'lag max':>8} {'inv':>5} {'loss':>6} {'r/fsync':>8} "
-        f"{'fsync ms':>9}  wal tail / disk state",
+        f"{'fsync ms':>9} {'transport':>14}  wal tail / disk state",
     ]
     for mid in sorted(data["members"]):
         m = data["members"][mid]
@@ -239,6 +246,15 @@ def render(data: Dict, top: int = 8) -> str:
         limp = m.get("limp") or {}
         ewma = limp.get("fsync_ewma_ms")
         fsync_ms = f"{ewma:.1f}" if ewma is not None else "-"
+        # Transport column: fabric kind; for shm, the worst outbound
+        # ring's current depth / high-water (KiB) — the backlog signal
+        # that precedes ring_full_drop.
+        fab = m.get("fabric") or "?"
+        lanes = m.get("fabric_lanes") or {}
+        if lanes:
+            depth = max(v.get("depth", 0) for v in lanes.values())
+            hw = max(v.get("high_water", 0) for v in lanes.values())
+            fab = f"{fab} {depth // 1024}/{hw // 1024}K"
         # The disk-state tail: wal tail classification, plus any live
         # fault-plane condition (limping / disk_full / fail-stop).
         disk = str(m["wal_tail"])
@@ -253,8 +269,8 @@ def render(data: Dict, top: int = 8) -> str:
             f"{m['fenced']:>7} {str(m.get('joint')):>6} "
             f"{str(m.get('learners')):>5} {m['lag_max']:>8} "
             f"{str(m['invariant_trips']):>5} "
-            f"{str(m['router_loss']):>6} {rpf:>8} {fsync_ms:>9}  "
-            f"{disk}")
+            f"{str(m['router_loss']):>6} {rpf:>8} {fsync_ms:>9} "
+            f"{fab:>14}  {disk}")
     lines.append("")
     lines.append(f"top-{top} laggards (cluster-wide):")
     if cl["top"]:

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Shm-fabric smoke for tools/check.sh (ISSUE 16): boot a tiny
+3-member cluster whose peers talk over the mmap ring fabric (one
+ShmFabric per member, all lanes under one shared directory — the same
+wiring hosting_proc --fabric=shm uses, minus the processes), drive one
+put wave across G=4 groups, and validate the full observability path:
+``fleet_console --once --json`` rollup with the shm transport column
+populated, per-lane frame counters moving, the etcd_tpu_shm_* metric
+families present in the exposition, and zero corrupt/undelivered
+frames. A broken ring layout, lane wiring, admin fabric stats, or
+console column fails the static gate, not a hosted run. One tiny
+compile (G=4); no worker processes.
+
+Writes artifacts/shmfabric_smoke.json (uploaded by lint.yml on
+failure).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+from contextlib import redirect_stdout
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+G, R = 4, 3
+
+OUT = os.path.join("artifacts", "shmfabric_smoke.json")
+
+
+def _fail(report, msg: str) -> int:
+    """Report the failure INTO the artifact too: lint.yml uploads it
+    under if: failure(), so the forensics must reflect the failing
+    run, not a stale prior success."""
+    report["ok"] = False
+    report["error"] = msg
+    _write(report)
+    print(f"shmfabric smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def _write(report) -> None:
+    os.makedirs("artifacts", exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+
+
+def main() -> int:
+    from etcd_tpu.batched.hosting import (
+        GroupKV,
+        MultiRaftMember,
+        wait_group_leaders,
+    )
+    from etcd_tpu.batched.hosting_proc import AdminServer
+    from etcd_tpu.batched.shmfabric import ShmFabric
+    from etcd_tpu.batched.state import BatchedConfig
+    from etcd_tpu.pkg import metrics as pmet
+
+    import fleet_console
+
+    cfg = BatchedConfig(
+        num_groups=G, num_replicas=R, window=8, max_ents_per_msg=2,
+        max_props_per_round=2, election_timeout=10,
+        heartbeat_timeout=1, pre_vote=True, check_quorum=True,
+        auto_compact=True, telemetry=True, fleet_summary=True,
+    )
+    tmp = tempfile.mkdtemp(prefix="shmfabric_smoke_")
+    shm_dir = os.path.join(tmp, "shmfabric")
+    report = {"ok": False, "groups": G, "members": R,
+              "shm_dir_relpath": "shmfabric"}
+
+    # MultiRaftCluster hard-wires InProcRouter, so build the members by
+    # hand: one ShmFabric each, every ordered pair wired as a lane.
+    members, fabrics, admins = {}, {}, []
+    try:
+        for mid in range(1, R + 1):
+            m = MultiRaftMember(mid, R, G, tmp, cfg=cfg)
+            fab = ShmFabric(m, shm_dir)
+            members[mid], fabrics[mid] = m, fab
+        for mid, fab in fabrics.items():
+            for other in members:
+                if other != mid:
+                    fab.add_peer(other)
+        for m in members.values():
+            m.start()
+
+        leads = wait_group_leaders(members.values, G, timeout=120.0)
+        report["leaders"] = [int(x) for x in leads]
+
+        # One put wave: a write per group, committed over the rings.
+        def put(group: int, key: bytes, value: bytes,
+                timeout: float = 30.0) -> bool:
+            payload = GroupKV.put_payload(key, value)
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                for m in members.values():
+                    if not m.propose(group, payload):
+                        continue
+                    sub = min(deadline, time.monotonic() + 2.0)
+                    while time.monotonic() < sub:
+                        if m.get(group, key) == value:
+                            return True
+                        time.sleep(0.005)
+                time.sleep(0.02)
+            return False
+
+        for g in range(G):
+            if not put(g, b"k%d" % g, b"v%d" % g):
+                return _fail(report, f"put for group {g} never committed")
+
+        # Every committed write must be visible on every member — the
+        # proof the rings actually replicated, not just elected.
+        deadline = time.monotonic() + 60.0
+        lagged = True
+        while time.monotonic() < deadline and lagged:
+            lagged = any(
+                m.get(g, b"k%d" % g) != b"v%d" % g
+                for m in members.values() for g in range(G))
+            if lagged:
+                time.sleep(0.05)
+        if lagged:
+            return _fail(report, "replication over shm never converged")
+
+        # The fabric's own books: frames flowed on live AND bulk rings,
+        # and nothing was corrupted or silently dropped.
+        lanes = {f"{mid}/{k}": v
+                 for mid, fab in fabrics.items()
+                 for k, v in fab.lane_stats().items()}
+        report["lanes"] = lanes
+        if not any(v["frames"] > 0 and k.endswith(":live")
+                   for k, v in lanes.items()):
+            return _fail(report, f"no live-ring frames: {lanes}")
+        if not any(v["frames"] > 0 and k.endswith(":bulk")
+                   for k, v in lanes.items()):
+            return _fail(report, f"no bulk-ring frames: {lanes}")
+        losses = {mid: fab.stats() for mid, fab in fabrics.items()}
+        report["losses"] = losses
+        for mid, st in losses.items():
+            for k in ("recv_corrupt", "deliver_error", "oversize_drop",
+                      "no_route"):
+                if st.get(k, 0):
+                    return _fail(report, f"member {mid} {k}={st[k]}")
+
+        # The shm metric families must be live in the exposition —
+        # dump_metrics/--watch consumers see the same registry.
+        expo = pmet.DEFAULT.expose()
+        for fam in ("etcd_tpu_shm_frames_total",
+                    "etcd_tpu_shm_copy_bytes_total",
+                    "etcd_tpu_shm_ring_bytes"):
+            if f"\n{fam}{{" not in expo and not expo.startswith(
+                    f"{fam}{{"):
+                return _fail(report, f"{fam} series missing from expose()")
+
+        # At least one summary frame folded per member, then the
+        # console rollup end to end (same contract as fleet_smoke).
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(m.fleet is not None and m.fleet.frames() > 0
+                   for m in members.values()):
+                break
+            time.sleep(0.05)
+        else:
+            return _fail(report, "members never folded a summary frame")
+
+        for mid, m in members.items():
+            admins.append(AdminServer(m, fabrics[mid], ("127.0.0.1", 0)))
+        addrs = [f"127.0.0.1:{a.addr[1]}" for a in admins]
+
+        deadline = time.monotonic() + 60.0
+        while True:
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                rc = fleet_console.main(
+                    ["--once", "--json"]
+                    + [x for a in addrs for x in ("--admin", a)])
+            if rc != 0:
+                return _fail(report, f"console exited {rc}: "
+                             f"{buf.getvalue()[-1500:]}")
+            data = json.loads(buf.getvalue())
+            probs = fleet_console.validate_rollup(data)
+            if probs:
+                return _fail(report, f"invalid rollup: {probs}")
+            cl = data["cluster"]
+            if cl["members_live"] != R:
+                return _fail(report,
+                             f"{cl['members_live']}/{R} members live")
+            if cl["leaders_total"] == G:
+                break
+            if time.monotonic() > deadline:
+                return _fail(report, f"leaders_total "
+                             f"{cl['leaders_total']} != {G}")
+            time.sleep(0.5)
+        if cl["invariant_trips_total"] != 0:
+            return _fail(report, f"invariant trips "
+                         f"{cl['invariant_trips_total']}")
+
+        # Transport column (satellite 4): every member reports the shm
+        # fabric kind + per-lane ring stats through the admin 'stats'
+        # op, and the rendered table carries it.
+        for mid, ent in data["members"].items():
+            if ent.get("fabric") != "shm":
+                return _fail(report, f"member {mid} fabric != shm: "
+                             f"{ent.get('fabric')}")
+            if not ent.get("fabric_lanes"):
+                return _fail(report,
+                             f"member {mid} missing fabric_lanes")
+        table = fleet_console.render(data)
+        if "shm " not in table:
+            return _fail(report, "transport column missing from table")
+
+        report["ok"] = True
+        report["rollup"] = cl
+        _write(report)
+        total = sum(v["frames"] for v in lanes.values())
+        print(f"shmfabric smoke OK: {cl['members_live']} members, "
+              f"{cl['leaders_total']} leaders over shm, "
+              f"{total} ring frames, losses "
+              f"{ {m: sum(s.values()) for m, s in losses.items()} }")
+        return 0
+    finally:
+        for a in admins:
+            a.close()
+        for fab in fabrics.values():
+            fab.stop()
+        for m in members.values():
+            m.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
