@@ -547,7 +547,11 @@ class ChaosHarness:
                  fence: bool = True,
                  trace: bool = False,
                  wal_pipeline: bool = False,
-                 wal_group_max_delay: Optional[float] = None) -> None:
+                 wal_group_max_delay: Optional[float] = None,
+                 snap_cadence: Optional[int] = None,
+                 snap_keep: int = 2,
+                 wal_rotate_bytes: Optional[int] = None,
+                 wal_pinned_segments: Optional[int] = None) -> None:
         assert transport in ("inproc", "tcp", "shm"), transport
         self.data_dir = data_dir
         self.seed = seed
@@ -592,6 +596,14 @@ class ChaosHarness:
         # same strict bar, or a pipeline reordering leaked.
         self.wal_pipeline = bool(wal_pipeline)
         self.wal_group_max_delay = wal_group_max_delay
+        # Log-lifecycle plane knobs (ISSUE 17): with a cadence and a
+        # rotation threshold set, every member snapshots/rotates/
+        # releases DURING the chaos episode — restarts replay from
+        # snapshot + rotated tail, and the same strict close applies.
+        self.snap_cadence = snap_cadence
+        self.snap_keep = snap_keep
+        self.wal_rotate_bytes = wal_rotate_bytes
+        self.wal_pinned_segments = wal_pinned_segments
         self.plan = FaultPlan(seed, spec)
         # Storage fault plane (ISSUE 15): every member's WAL handle is
         # born with this plan's hook threaded in (restarts re-thread it
@@ -648,6 +660,11 @@ class ChaosHarness:
             wal_pipeline=self.wal_pipeline or None,
             wal_group_max_delay=self.wal_group_max_delay,
             disk_fault_hook=self.disk.hook_for(mid),
+            snap_cadence=self.snap_cadence,
+            snap_keep=self.snap_keep,
+            wal_rotate_bytes=self.wal_rotate_bytes,
+            **({"wal_pinned_segments": self.wal_pinned_segments}
+               if self.wal_pinned_segments is not None else {}),
         )
         if self.inproc is not None:
             self.inproc.attach(m)

@@ -70,6 +70,7 @@ from ..native.walog import (
 from ..obs.tracer import make_tracer
 from ..pkg.failpoint import FailpointPanic, fp
 from ..raft.confchange import ConfChangeError
+from ..storage.snap import NoSnapshotError, Snapshotter
 from ..raft.types import (
     ConfChangeSingle,
     ConfChangeTransition,
@@ -139,6 +140,18 @@ RT_WM_BATCH = 7
 # entries ABOVE the recorded watermark (committed but crashed before
 # the record landed) re-apply from the recovered entries themselves.
 RT_CONF_BATCH = 8
+# File-snapshot markers (log-lifecycle plane): rows of (group, index,
+# term) naming a snapshot FILE (storage/snap.Snapshotter, per-group
+# dirs under member-N/snap/) this member made durable — the etcd
+# architecture, where snapshot data lives in files and the WAL carries
+# only the marker (ref: walpb.Snapshot records). A marker is trusted
+# only once its covering fsync lands (the file itself is fsync'd
+# BEFORE the marker is appended), and _replay loads the newest file
+# matching a durable marker when the full RT_SNAPSHOT record has been
+# rotated out of the WAL. Also re-recorded wholesale in every
+# rotation checkpoint, so release never strands a group's only
+# snapshot evidence in a reclaimed segment.
+RT_SNAPMARK = 9
 
 # Per-entry header inside an RT_ENTRY_BATCH record (packed, 25 bytes —
 # the same fields as RT_ENTRY's "<IQQBI" header, SoA-serializable).
@@ -155,6 +168,10 @@ WAL_HS_DTYPE = np.dtype([
 WAL_WM_DTYPE = np.dtype([
     ("group", "<u4"), ("last", "<u8"), ("last_term", "<u8"),
     ("commit", "<u8"),
+])
+# Rows of RT_SNAPMARK (file-snapshot markers).
+WAL_SNAPMARK_DTYPE = np.dtype([
+    ("group", "<u4"), ("index", "<u8"), ("term", "<u8"),
 ])
 
 
@@ -252,6 +269,19 @@ def _env_wal_pipeline() -> bool:
 WAL_GROUP_MAX_DELAY_S = 0.0
 WAL_GROUP_MAX_BYTES = 4 << 20
 
+# Log-lifecycle plane defaults (member args, like the pipeline knobs —
+# never BatchedConfig fields: host-only, must not fork a compile).
+# snap_cadence / wal_rotate_bytes default to None = OFF, preserving
+# pre-lifecycle behavior for every existing caller.
+SNAP_KEEP_DEFAULT = 2        # snapshot files retained per group
+WAL_LIFECYCLE_TICK_S = 0.05  # commit-worker idle lifecycle cadence
+SNAP_BUILD_MAX_PER_PASS = 64  # due-group snapshot builds per drain
+# pass — bounds the work a single pass steals from the round loop (the
+# most-overdue groups go first; the rest catch the next pass)
+WAL_PINNED_SEGMENTS = 4      # sealed-but-unreleasable segments before
+# the counted wal_pinned anomaly fires (a stuck group must become
+# protocol-visible instead of silently pinning disk)
+
 
 class _PersistGroup:
     """One submitted persistence batch riding the WAL pipeline: the
@@ -343,6 +373,10 @@ class MultiRaftMember:
         wal_group_max_delay: Optional[float] = None,
         wal_group_max_bytes: Optional[int] = None,
         disk_fault_hook: Optional[Callable[[str, int], None]] = None,
+        snap_cadence: Optional[int] = None,
+        snap_keep: int = SNAP_KEEP_DEFAULT,
+        wal_rotate_bytes: Optional[int] = None,
+        wal_pinned_segments: int = WAL_PINNED_SEGMENTS,
     ) -> None:
         self.id = member_id
         self.slot = member_id - 1
@@ -452,6 +486,51 @@ class MultiRaftMember:
         # whose leadership moved mid-joint.
         self._joint_prop: Dict[int, float] = {}
         self._next_joint_sweep = 0.0
+
+        # Log-lifecycle plane (cadence snapshots, WAL rotation/release,
+        # ring back-pressure). Both knobs default OFF; the state below
+        # is initialized before _replay() because replay reconstructs
+        # it from the surviving segments. All guarded by _lock except
+        # where noted.
+        self.snap_cadence = (
+            None if snap_cadence is None else max(1, int(snap_cadence)))
+        self.snap_keep = max(1, int(snap_keep))
+        self.wal_rotate_bytes = (
+            None if wal_rotate_bytes is None else int(wal_rotate_bytes))
+        self.wal_pinned_segments = max(1, int(wal_pinned_segments))
+        # Newest durable FILE snapshot per group (what cadence measures
+        # against and rotation checkpoints re-record as RT_SNAPMARK).
+        self._snap_file_idx = np.zeros(num_groups, np.int64)
+        self._snap_file_term = np.zeros(num_groups, np.int64)
+        # Release-math cover per group: the newest snapshot EVIDENCE
+        # (file marker or RT_SNAPSHOT install record) at _snap_cover[g],
+        # whose WAL record lives in segment _snap_seq[g]. A sealed
+        # segment s is reclaimable only when, for every group with
+        # entries in s (cap > 0), cover >= cap AND the evidence sits in
+        # a LATER segment than s — releasing the evidence with the
+        # segment would turn the snapshot into an unprovable file.
+        self._snap_cover = np.zeros(num_groups, np.int64)
+        self._snap_seq = np.zeros(num_groups, np.int64)
+        # Sealed (cut) segments awaiting release, oldest first:
+        # {"seq", "meta", "cap"} where cap[g] = g's durable last index
+        # at seal time (every entry the segment holds is <= cap[g]).
+        self._sealed: List[Dict] = []
+        self._wal_meta = 0          # current tail segment's meta
+        self._ckpt_seq = -1         # seq of the last durable checkpoint
+        self._need_ckpt = False     # rotation happened / boot-with-
+        # history: (re)write the full-state checkpoint into the tail
+        self._last_sync_seq = 0     # tail seq at the last fsync (set
+        # under _wal_io; read by the install cover fold)
+        self._tail_ckpt_bytes = 0   # checkpoint bytes in the current
+        # tail: the cut threshold EXCLUDES them, or at large G a
+        # checkpoint bigger than wal_rotate_bytes would cut-storm
+        # (every cut writes a checkpoint that immediately re-arms the
+        # next cut)
+        self._wal_pinned_flag = False
+        self._pinned_group = -1
+        self._ring_occ_hw = 0       # ring-occupancy high-water (host)
+        self._snap_file_count = 0
+        self._snappers: Dict[int, "Snapshotter"] = {}
 
         restore = self._replay()
         groups = np.arange(num_groups, dtype=np.int32)
@@ -626,6 +705,18 @@ class MultiRaftMember:
         ents: Dict[int, List[Tuple[int, int, bytes]]] = defaultdict(list)
         snaps: Dict[int, Tuple[int, int, bytes]] = {}
         wms: Dict[int, Tuple[int, int, int]] = {}
+        # Lifecycle evidence gathered during the scan: per-segment
+        # per-group max entry index (rebuilds the sealed-segment caps),
+        # snapshot-file markers per group, and the segment each
+        # group's newest in-WAL snapshot evidence lives in.
+        seg_caps: Dict[int, Dict[int, int]] = defaultdict(dict)
+        marks: Dict[int, List[Tuple[int, int, int]]] = defaultdict(list)
+        snap_src_seq: Dict[int, int] = {}
+
+        def _cap(seq: int, g: int, i: int) -> None:
+            sc = seg_caps[seq]
+            if i > sc.get(g, 0):
+                sc[g] = i
         # read_all_classified snapshots the tail shape BEFORE the
         # repairing read (which truncates the mid-record evidence) —
         # the ordering protocol-aware recovery rests on, kept
@@ -657,7 +748,7 @@ class MultiRaftMember:
             # must report what the boot found, not the amputated
             # aftermath.
             self._tail_state = TAIL_CORRUPT
-        for rtype, data, _seq, _meta in records:
+        for rtype, data, rec_seq, _meta in records:
             if rtype == RT_HARDSTATE:
                 g, term, vote, commit = _unpack_hs(data)
                 rr = rows[g]
@@ -668,16 +759,20 @@ class MultiRaftMember:
                 while lst and lst[-1][0] >= i:
                     lst.pop()  # WAL truncate-and-append semantics
                 lst.append((i, t, d, et))
+                _cap(rec_seq, g, i)
             elif rtype == RT_ENTRY_BATCH:
                 for g, i, t, d, et in _iter_entry_batch(data):
                     lst = ents[g]
                     while lst and lst[-1][0] >= i:
                         lst.pop()  # truncate-and-append per entry
                     lst.append((i, t, d, et))
+                    _cap(rec_seq, g, i)
             elif rtype == RT_SNAPSHOT:
                 g, i, t, d, _et = _unpack_snap(data)
                 snaps[g] = (i, t, d)
+                snap_src_seq[g] = rec_seq
                 ents[g] = [e for e in ents[g] if e[0] > i]
+                _cap(rec_seq, g, i)
             elif rtype == RT_WATERMARK:
                 # Latest record wins: `last` legitimately moves DOWN on
                 # a conflict truncation (a new leader overwriting an
@@ -706,6 +801,42 @@ class MultiRaftMember:
                         GroupConfStore.unpack_groups(
                             data, self.cfg.num_replicas):
                     self.conf.load_record(g, idx, flags, slots)
+            elif rtype == RT_SNAPMARK:
+                mk = _unpack_batch(data, WAL_SNAPMARK_DTYPE)
+                for g, i, t in zip(mk["group"].tolist(),
+                                   mk["index"].tolist(),
+                                   mk["term"].tolist()):
+                    marks[g].append((i, t, rec_seq))
+        # File-backed snapshots (RT_SNAPMARK): when a group's newest
+        # durable marker names an index beyond any RT_SNAPSHOT record
+        # still in the WAL (the full record may live in a released
+        # segment), restore from the snapshot FILE. Markers are only
+        # written after the file's fsync, and load_newest_available
+        # skips corrupt/partial files — a missing file falls back to
+        # older evidence, and any acked state thereby lost is caught
+        # by the durable-watermark fence below.
+        for g, cand in marks.items():
+            best = max(i for i, _t, _s in cand)
+            if best <= snaps.get(g, (0, 0, b""))[0]:
+                continue
+            try:
+                snap = self._snapper(g).load_newest_available(
+                    [(i, t) for i, t, _s in cand])
+            except NoSnapshotError:
+                continue
+            md = snap.metadata
+            if md.index > snaps.get(g, (0, 0, b""))[0]:
+                snaps[g] = (md.index, md.term, snap.data)
+                ents[g] = [e for e in ents[g] if e[0] > md.index]
+                snap_src_seq[g] = max(
+                    (s for i, t, s in cand
+                     if i == md.index and t == md.term), default=0)
+                cs = md.conf_state
+                if cs is not None:
+                    # Supersedes the skipped conf entries the released
+                    # segments held (no-op at/below the conf
+                    # watermark, same as the install path).
+                    self.conf.restore(g, md.index, cs)
         restore: Dict[int, RowRestore] = {}
         for g in set(rows) | set(ents) | set(snaps):
             rr = rows[g]
@@ -714,6 +845,16 @@ class MultiRaftMember:
             rr.snap_index, rr.snap_term = si, st_
             rr.applied = si
             rr.entries = [e for e in ents.get(g, []) if e[0] > si]
+            # Contiguity guard: release only ever reclaims entries a
+            # snapshot covers, so a gap ABOVE the restored snapshot
+            # means the newest snapshot file was unreadable and an
+            # older restore point took over. Keep the contiguous
+            # prefix — the watermark fence below makes the loss
+            # protocol-visible and catch-up re-ships the rest.
+            for j, e in enumerate(rr.entries):
+                if e[0] != si + 1 + j:
+                    rr.entries = rr.entries[:j]
+                    break
             lim = rr.snap_index + len(rr.entries)
             rr.commit = min(rr.commit, lim) if rr.commit else rr.commit
             # BatchedRawNode._restore clamps commit up to snap_index (a
@@ -792,6 +933,59 @@ class MultiRaftMember:
                 TAIL_NAMES.get(self._tail_state, self._tail_state),
                 self._boot_fenced,
                 np.nonzero(self._fenced)[0][:16].tolist())
+        # -- log-lifecycle state from the surviving segments ----------------
+        # Sealed list + caps from the on-disk segment names (all but
+        # the highest seq are sealed; caps are the running per-group
+        # max entry index up to and including each segment). A boot
+        # with sealed segments owes the new tail a checkpoint before
+        # anything can release (_ckpt_seq starts unproven).
+        segs: List[Tuple[int, int]] = []
+        for fname in os.listdir(wal_dir):
+            if not fname.endswith(".wal") or len(fname) < 37:
+                continue
+            try:
+                segs.append((int(fname[0:16], 16), int(fname[17:33], 16)))
+            except ValueError:
+                continue
+        segs.sort()
+        if segs:
+            self._wal_meta = segs[-1][1]
+            run_cap: Dict[int, int] = {}
+            for sseq, smeta in segs[:-1]:
+                for g, i in seg_caps.get(sseq, {}).items():
+                    if i > run_cap.get(g, 0):
+                        run_cap[g] = i
+                cap = np.zeros(self.g, np.int64)
+                for g, i in run_cap.items():
+                    cap[g] = i
+                self._sealed.append(
+                    {"seq": sseq, "meta": smeta, "cap": cap})
+            self._need_ckpt = bool(self._sealed)
+        # Snapshot covers: what each group actually restored from,
+        # with the segment holding its WAL evidence; file bookkeeping
+        # from the newest durable marker (cadence measures its
+        # applied-delta against the newest FILE, even when the restore
+        # itself used a newer RT_SNAPSHOT record).
+        for g, (si, st_, _sd) in snaps.items():
+            if si > 0:
+                self._snap_cover[g] = si
+                self._snap_seq[g] = int(snap_src_seq.get(g, 0))
+        for g, cand in marks.items():
+            mi, mt, _ms = max(cand, key=lambda c: c[0])
+            self._snap_file_idx[g] = mi
+            self._snap_file_term[g] = mt
+        snap_root = os.path.join(self.dir, "snap")
+        if os.path.isdir(snap_root):
+            total = 0
+            for sub in os.listdir(snap_root):
+                try:
+                    total += sum(
+                        1 for n in os.listdir(
+                            os.path.join(snap_root, sub))
+                        if n.endswith(".snap"))
+                except (NotADirectoryError, OSError):
+                    continue
+            self._snap_file_count = total
         return restore
 
     # -- loops -----------------------------------------------------------------
@@ -1088,6 +1282,10 @@ class MultiRaftMember:
         fp(self._fp_after_save)  # crash-after-save-before-apply site
         for rd in batch:
             self._apply_and_send(rd)
+        # Lifecycle work rides the drain AFTER the batch's covering
+        # fsync and release (pipeline mode runs the same pass at the
+        # end of each commit wave instead).
+        self._lifecycle_pass()
 
     # -- IO-error contract (ISSUE 15) ------------------------------------------
     #
@@ -1157,6 +1355,10 @@ class MultiRaftMember:
                 if self._wal_closed:
                     return False
                 self.wal.flush(sync=True)
+                # Everything serialized above is now durable in the
+                # current tail segment — snapshot-install covers fold
+                # with this seq as their WAL-evidence segment.
+                self._last_sync_seq = int(self.wal.tail_seq())
         except Exception as e:  # noqa: BLE001 — first failed fsync
             self._io_fail_stop("fsync", e)
             return False
@@ -1219,6 +1421,393 @@ class MultiRaftMember:
             "from the failed window is released", self.id, stage, exc)
         self.crash()
 
+    # -- log-lifecycle plane (ISSUE 17) ----------------------------------------
+    #
+    # Bounded growth over a long life, three lanes:
+    #
+    # * **snapshot cadence** — when applied-minus-file-snapshot crosses
+    #   snap_cadence, the group's snapshot is built OFF the apply
+    #   stream (batched across due groups per drain pass): file first
+    #   (fsync'd, tmp+rename), then one RT_SNAPMARK batch whose
+    #   covering fsync gates the cover fold and the keep-K retention
+    #   prune — the WAL pipeline's release-barrier discipline, reused.
+    # * **rotation + release** — past wal_rotate_bytes the tail is cut
+    #   (native cut() fdatasyncs the sealed fd: seal == durable) with
+    #   cap[g] = the durable last per group, a full-state checkpoint
+    #   (hardstate/watermark/conf/markers) opens the new tail, and a
+    #   sealed segment releases only when every group with entries in
+    #   it (cap > 0) has snapshot cover >= cap with the evidence in a
+    #   LATER segment. Fenced groups never build new snapshots, so
+    #   their segments stay pinned until the fence heals — a fence
+    #   demand can never dangle into a released segment — and a stuck
+    #   group surfaces as the counted wal_pinned anomaly instead of
+    #   silently eating the disk.
+    # * **ring back-pressure** — propose() refuses with a typed
+    #   ring_full (counted, health-visible) at the exact occupancy
+    #   where the device headroom clamp would drop the proposal, and
+    #   kernels.invariant_bits trips ring_over_window if an append
+    #   ever crosses the floor.
+
+    def _snapper(self, group: int) -> Snapshotter:
+        """Per-group snapshot file store (member-N/snap/gXXXXX/),
+        created lazily — eager creation would mkdir G directories on
+        every boot. Shares the WAL's disk-fault seam."""
+        sp = self._snappers.get(group)
+        if sp is None:
+            sp = self._snappers[group] = Snapshotter(
+                os.path.join(self.dir, "snap", f"g{group:05d}"),
+                fault_hook=self._disk_fault_hook)
+        return sp
+
+    def _append_synced(
+            self, records: List[Tuple[int, bytes]]) -> Optional[int]:
+        """Append + fsync standalone lifecycle records (snapshot
+        markers) with the IO-error contract applied. Returns the tail
+        segment seq the records landed in, or None when nothing became
+        durable (ENOSPC / member dead) — the caller retries on a later
+        pass. Never called with _lock or _wal_io held."""
+        fail: Optional[BaseException] = None
+        with self._wal_io:
+            if self._wal_closed:
+                return None
+            try:
+                for rt, data in records:
+                    self.wal.append(rt, data)
+                self.wal.flush(sync=True)
+                seq = int(self.wal.tail_seq())
+                self._last_sync_seq = seq
+                return seq
+            except Exception as e:  # noqa: BLE001 — classified below
+                fail = e
+        if is_disk_full(fail):
+            # Seam guarantee: the failing record never reached the
+            # buffer; anything appended before it rides the next
+            # covering fsync. No dwell — lifecycle work just waits.
+            self._enter_disk_full()
+        else:
+            self._io_fail_stop("lifecycle", fail)
+        return None
+
+    def _checkpoint_records_locked(self) -> List[Tuple[int, bytes]]:
+        """Full-state checkpoint for the (new) tail segment — caller
+        holds _lock. Watermark + hardstate rows for every live group,
+        conf rows for every non-default group, snapshot markers for
+        every file-covered group: any such record a release reclaims
+        from an old segment is superseded by this copy first. Fenced
+        rows re-record their boot demand (the _wm arrays never lower
+        it), so the fence survives rotation; term/vote from the round
+        mirrors may run AHEAD of the last fsync'd record, which is the
+        safe direction (persisting a vote early can never un-promise
+        one). Entries are the one thing a checkpoint cannot re-record —
+        the per-segment caps gate those."""
+        recs: List[Tuple[int, bytes]] = []
+        wmg = np.nonzero((self._wm_last > 0) | (self._wm_commit > 0))[0]
+        if wmg.size:
+            recs.append((RT_WM_BATCH, _pack_rows(WAL_WM_DTYPE, {
+                "group": wmg, "last": self._wm_last[wmg],
+                "last_term": self._wm_term[wmg],
+                "commit": self._wm_commit[wmg]})))
+        rn = self.rn
+        live = np.nonzero((rn.m_term > 0) | (rn.m_vote > 0)
+                          | (rn.m_commit > 0))[0]
+        if live.size:
+            recs.append((RT_HS_BATCH, _pack_rows(WAL_HS_DTYPE, {
+                "group": live, "term": rn.m_term[live],
+                "vote": rn.m_vote[live],
+                "commit": rn.m_commit[live]})))
+        conf_rows = self.conf.non_default_groups()
+        if len(conf_rows):
+            recs.append((RT_CONF_BATCH,
+                         self.conf.pack_groups(conf_rows)))
+        covered = np.nonzero(self._snap_file_idx > 0)[0]
+        if covered.size:
+            recs.append((RT_SNAPMARK, _pack_rows(WAL_SNAPMARK_DTYPE, {
+                "group": covered,
+                "index": self._snap_file_idx[covered],
+                "term": self._snap_file_term[covered]})))
+        return recs
+
+    def _lifecycle_pass(self) -> None:
+        """One bounded lifecycle step, riding the inline drain or the
+        WAL-commit worker AFTER a covering fsync (never with _lock or
+        _wal_io held on entry). Work per pass is capped, so the round
+        loop never stalls behind snapshot building."""
+        if self.snap_cadence is None and self.wal_rotate_bytes is None:
+            return
+        if (self._crashed or self._disk_full
+                or self._fail_stop_cause is not None):
+            return
+        occ = int((self.rn.m_last - self.rn.m_snap).max())
+        if occ > self._ring_occ_hw:
+            self._ring_occ_hw = occ
+        if self.snap_cadence is not None:
+            self._snapshot_due_groups()
+        if self.wal_rotate_bytes is not None:
+            self._rotate_and_release()
+
+    def _snapshot_due_groups(self) -> None:
+        """Cadence snapshots, batched across due groups: capture
+        (index, term, conf, KV blob) under _lock off the apply stream,
+        write the files OUTSIDE every lock, then append ONE RT_SNAPMARK
+        batch — the cover fold and the keep-K retention prune run only
+        once the marker's fsync landed. Fenced groups are skipped: a
+        fenced group's cover stays frozen, so release keeps every
+        segment its un-healed demand may point into."""
+        cad = self.snap_cadence
+        builds: List[Tuple[int, int, int, bytes, object]] = []
+        with self._lock:
+            if self._crashed:
+                return
+            delta = self.applied_index - self._snap_file_idx
+            # Catch-up lag: groups whose cover (or marker evidence)
+            # still pins the OLDEST sealed segment build regardless of
+            # cadence — without this, a group idling 1-2 applied
+            # entries past its last snapshot (delta < cadence) would
+            # pin that segment forever. Only groups a rebuild can
+            # actually help: applied past the cover, or a fresh marker
+            # needed as release evidence.
+            lag = np.zeros(self.g, dtype=bool)
+            if self._sealed:
+                s0 = self._sealed[0]
+                cap0 = s0["cap"]
+                lag = (cap0 > 0) & (
+                    ((self._snap_cover < cap0)
+                     & (self.applied_index > self._snap_cover))
+                    | ((self._snap_cover >= cap0)
+                       & (self._snap_seq <= s0["seq"])))
+            due = np.nonzero(((delta >= cad) | lag) & ~self._fenced
+                             & (self.applied_index > 0))[0]
+            if due.size == 0:
+                return
+            # Build cap scales with the fleet so steady-state cover
+            # refresh keeps pace with rotation at large G; laggards
+            # outrank merely-due groups under the cap.
+            cap_n = max(SNAP_BUILD_MAX_PER_PASS, self.g // 8)
+            if due.size > cap_n:
+                prio = delta[due] + np.where(lag[due], 1 << 32, 0)
+                order = np.argsort(-prio, kind="stable")
+                due = due[order[:cap_n]]
+            m_last = self.rn.m_last
+            ring = self.rn.m_ring
+            w = self.cfg.window
+            for g in due.tolist():
+                idx = int(self.applied_index[g])
+                last = int(m_last[g])
+                # Term at idx from the host ring mirror: valid only
+                # while idx is inside the mirrored window (committed
+                # slots never rewrite, so mirror staleness is safe; a
+                # group at the window edge catches the next pass).
+                if idx <= last - w or idx > last:
+                    continue
+                term = int(ring[g, idx % w])
+                if term <= 0:
+                    continue
+                builds.append((g, idx, term, self.kvs[g].snapshot(),
+                               self.conf.conf_state(g)))
+        if not builds:
+            return
+        built: List[Tuple[int, int, int]] = []
+        for g, idx, term, data, cs in builds:
+            snap = Snapshot(
+                metadata=SnapshotMetadata(
+                    index=idx, term=term, conf_state=cs),
+                data=data)
+            try:
+                self._snapper(g).save_snap(snap)
+            except Exception as e:  # noqa: BLE001 — classified below
+                # tmp+rename is all-or-nothing: a failed build leaves
+                # the previous file intact and the WAL still holds
+                # everything, so skip-and-retry is loss-free (and each
+                # attempt opens a FRESH tmp file — no retried-fsync
+                # dirty-page hazard). ENOSPC enters back-pressure.
+                self.stats["snap_build_errors"] = (
+                    self.stats.get("snap_build_errors", 0) + 1)
+                if is_disk_full(e):
+                    self._enter_disk_full()
+                    break
+                continue
+            built.append((g, idx, term))
+        if not built:
+            return
+        rows = np.array(built, np.int64)
+        marker = (RT_SNAPMARK, _pack_rows(WAL_SNAPMARK_DTYPE, {
+            "group": rows[:, 0], "index": rows[:, 1],
+            "term": rows[:, 2]}))
+        seq = self._append_synced([marker])
+        if seq is None:
+            return  # files exist; the marker retries a later pass
+        fresh = set()
+        with self._lock:
+            if self._crashed:
+                return
+            for g, idx, term in built:
+                if idx > int(self._snap_file_idx[g]):
+                    self._snap_file_idx[g] = idx
+                    self._snap_file_term[g] = term
+                    fresh.add(g)  # new file; same-idx catch-up
+                    # rebuilds overwrite in place
+                if idx >= int(self._snap_cover[g]):
+                    self._snap_cover[g] = idx
+                    self._snap_seq[g] = max(int(self._snap_seq[g]),
+                                            seq)
+            self.stats["snapshots_built"] = (
+                self.stats.get("snapshots_built", 0) + len(built))
+        for g, idx, _t in built:
+            pruned = self._snapper(g).retain(self.snap_keep)
+            self.stats["snap_files_pruned"] = (
+                self.stats.get("snap_files_pruned", 0) + pruned)
+            self._snap_file_count += (1 if g in fresh else 0) - pruned
+            # Advance the device ring floor to the snapshot point
+            # (staged on the rawnode, clamped to commit at the round
+            # head): auto_compact's conservative floor trails applied
+            # by window//2; this reclaims the rest of the headroom.
+            self.rn.compact(g, idx)
+
+    def _rotate_and_release(self) -> None:
+        """Seal the tail past the byte threshold, checkpoint the new
+        tail, release every sealed segment the fleet-min snapshot
+        cover clears, and raise wal_pinned when the backlog of
+        unreleasable segments crosses the threshold."""
+        rot = self.wal_rotate_bytes
+        fail: Optional[BaseException] = None
+        ckpt_full = False
+        release_meta: Optional[int] = None
+        anomaly: Optional[Dict] = None
+        with self._lock:
+            if self._crashed:
+                return
+            with self._wal_io:
+                if self._wal_closed:
+                    return
+                try:
+                    if (self.wal.tail_offset()
+                            >= rot + self._tail_ckpt_bytes):
+                        seq = int(self.wal.tail_seq())
+                        cap = self._dur_last.copy()
+                        # cut() fdatasyncs the sealed segment's fd
+                        # before switching: seal == durable, and cap
+                        # (folded only after covering fsyncs) bounds
+                        # every entry index the segment holds.
+                        self.wal.cut(self._wal_meta + 1)
+                        self._sealed.append(
+                            {"seq": seq, "meta": self._wal_meta,
+                             "cap": cap})
+                        self._wal_meta += 1
+                        self._tail_ckpt_bytes = 0
+                        self.stats["wal_cuts"] = (
+                            self.stats.get("wal_cuts", 0) + 1)
+                        self._need_ckpt = True
+                except Exception as e:  # noqa: BLE001 — a failed cut
+                    # leaves the native tail state unknowable: the
+                    # fail-stop arm, like any failed fsync.
+                    fail = e
+                if fail is None and self._need_ckpt:
+                    # Checkpoint ATOMICALLY with the cut (still under
+                    # _lock): no install can slip a newer hardstate
+                    # into the sealed segment after our capture, so
+                    # everything a release reclaims is genuinely
+                    # superseded by this copy.
+                    try:
+                        ckpt = self._checkpoint_records_locked()
+                        for rt, d in ckpt:
+                            self.wal.append(rt, d)
+                        self.wal.flush(sync=True)
+                        self._tail_ckpt_bytes += sum(
+                            len(d) + 16 for _rt, d in ckpt)
+                        cseq = int(self.wal.tail_seq())
+                        self._last_sync_seq = cseq
+                        self._ckpt_seq = cseq
+                        self._need_ckpt = False
+                        cov = self._snap_file_idx > 0
+                        self._snap_seq[cov] = np.maximum(
+                            self._snap_seq[cov], cseq)
+                    except Exception as e:  # noqa: BLE001
+                        if is_disk_full(e):
+                            ckpt_full = True  # retry next pass
+                        else:
+                            fail = e
+            if fail is None and self._sealed and self._ckpt_seq >= 0:
+                k = 0
+                for s in self._sealed:
+                    if s["seq"] >= self._ckpt_seq:
+                        break  # its checkpoint lives in a later
+                        # segment only once a NEWER one is written
+                    need = s["cap"] > 0
+                    if not bool(np.all(~need | (
+                            (self._snap_cover >= s["cap"])
+                            & (self._snap_seq > s["seq"])))):
+                        break  # prefix-only: later segments need this
+                        # one's predecessors gone first anyway
+                    k += 1
+                if k:
+                    release_meta = (
+                        self._sealed[k]["meta"]
+                        if k < len(self._sealed) else self._wal_meta)
+                    del self._sealed[:k]
+            if fail is None:
+                if len(self._sealed) > self.wal_pinned_segments:
+                    if not self._wal_pinned_flag:
+                        self._wal_pinned_flag = True
+                        self.stats["wal_pinned_events"] = (
+                            self.stats.get("wal_pinned_events", 0) + 1)
+                        s = self._sealed[0]
+                        lag = (s["cap"] > 0) & (
+                            (self._snap_cover < s["cap"])
+                            | (self._snap_seq <= s["seq"]))
+                        gap = np.where(
+                            lag, s["cap"] - self._snap_cover, -1)
+                        self._pinned_group = (
+                            int(np.argmax(gap)) if lag.any() else -1)
+                        anomaly = {
+                            "segments": len(self._sealed),
+                            "oldest_seq": int(s["seq"]),
+                            "group": self._pinned_group,
+                            "gap": int(gap.max()) if lag.any() else 0,
+                            "fenced": bool(
+                                self._fenced[self._pinned_group])
+                            if self._pinned_group >= 0 else False,
+                        }
+                else:
+                    # Edge-triggered: re-arms after the backlog drains.
+                    self._wal_pinned_flag = False
+                    self._pinned_group = -1
+        if fail is not None:
+            self._io_fail_stop("rotate", fail)
+            return
+        if ckpt_full:
+            self._enter_disk_full()
+            return
+        if release_meta is not None:
+            with self._wal_io:
+                if not self._wal_closed:
+                    try:
+                        n = self.wal.release_before(release_meta)
+                    except Exception as e:  # noqa: BLE001
+                        self._io_fail_stop("release", e)
+                        return
+                    self.stats["wal_segments_released"] = (
+                        self.stats.get("wal_segments_released", 0)
+                        + n)
+        if anomaly is not None:
+            _log.warning(
+                "member %d: wal_pinned — %d sealed segment(s) "
+                "unreleasable, pinned by group %s (cover gap %s%s)",
+                self.id, anomaly["segments"], anomaly["group"],
+                anomaly["gap"],
+                ", fenced" if anomaly["fenced"] else "")
+            if self.fleet is not None:
+                self.fleet.raise_anomaly("wal_pinned", anomaly)
+
+    def _ring_full(self, group: int) -> bool:
+        """Host twin of the device propose-headroom clamp: occupancy
+        (last minus compaction floor) has reached the window minus the
+        per-round proposal quota, so a staged proposal would be
+        dropped on device anyway. Refusing HERE makes the
+        back-pressure typed — counted, health-visible — instead of a
+        silent device-side drop."""
+        occ = int(self.rn.m_last[group]) - int(self.rn.m_snap[group])
+        return occ >= self.cfg.window - self.cfg.max_props_per_round
+
     # -- WAL-commit worker (async group-commit pipeline, ISSUE 13) -------------
 
     def _wal_commit_loop(self) -> None:
@@ -1231,12 +1820,31 @@ class MultiRaftMember:
         member, never swallowed."""
         try:
             while True:
+                idle = False
                 with self._wal_cv:
                     while not self._wal_pending and not self._wal_stop:
-                        self._wal_cv.wait()
+                        if (self.snap_cadence is not None
+                                or self.wal_rotate_bytes is not None):
+                            # Lifecycle on: bounded wait so cadence
+                            # builds, cuts and releases keep making
+                            # progress through idle gaps — without the
+                            # tick, a quiet pipeline would freeze the
+                            # lifecycle plane until the next write.
+                            self._wal_cv.wait(WAL_LIFECYCLE_TICK_S)
+                            if (not self._wal_pending
+                                    and not self._wal_stop):
+                                idle = True
+                                break
+                        else:
+                            self._wal_cv.wait()
                     wave = self._wal_pending
                     self._wal_pending = []
                     stopping = self._wal_stop
+                if idle and not wave and not stopping:
+                    # Idle lifecycle tick: still THIS thread, so every
+                    # cut/checkpoint stays serialized with wave appends.
+                    self._lifecycle_pass()
+                    continue
                 if not wave:
                     return  # stop() with nothing pending
                 nbytes = sum(g.nbytes for g in wave)
@@ -1327,6 +1935,11 @@ class MultiRaftMember:
                     if self._wal_closed:
                         return
                     self.wal.flush(sync=True)
+                    # Wave durable in the current tail (cuts happen
+                    # only on THIS worker, so every record appended
+                    # above landed in it): snapshot-install covers
+                    # fold with this seq as their evidence segment.
+                    self._last_sync_seq = int(self.wal.tail_seq())
             except Exception as e:  # noqa: BLE001 — first failed fsync
                 # Fail-stop releasing NOTHING covered by the failed
                 # window: every batch queued behind this group-commit
@@ -1393,6 +2006,10 @@ class MultiRaftMember:
                 self._m_wal_release.observe(now - g.t_submit)
             for rd in g.readys:
                 self._apply_and_send(rd)
+        # Lifecycle work rides the commit worker after the wave's
+        # release — same thread as every cut/checkpoint, so segment
+        # rotation never races the wave appends above.
+        self._lifecycle_pass()
 
     def _apply_and_send(self, rd: BatchedReady) -> None:
         if self._crashed:
@@ -1866,8 +2483,70 @@ class MultiRaftMember:
             "max_delay_s": self._wal_max_delay,
             "max_bytes": self._wal_max_bytes,
         }
+        # Log-lifecycle visibility (ISSUE 17): segments + bytes on
+        # disk, the oldest still-pinned sealed segment and the group
+        # pinning it, snapshot-file census, and the ring back-pressure
+        # high-water — fleet_console's lifecycle columns read this.
+        wal_dir = os.path.join(self.dir, "wal")
+        wal_segments = 0
+        wal_bytes = 0
+        try:
+            for fname in os.listdir(wal_dir):
+                if fname.endswith(".wal"):
+                    wal_segments += 1
+                    try:
+                        wal_bytes += os.path.getsize(
+                            os.path.join(wal_dir, fname))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        with self._lock:
+            sealed = len(self._sealed)
+            oldest = (int(self._sealed[0]["seq"])
+                      if self._sealed else -1)
+            pinned_group = self._pinned_group
+            wal_pinned = self._wal_pinned_flag
+        lifecycle = {
+            "enabled": (self.snap_cadence is not None
+                        or self.wal_rotate_bytes is not None),
+            "snap_cadence": self.snap_cadence,
+            "snap_keep": self.snap_keep,
+            "wal_rotate_bytes": self.wal_rotate_bytes,
+            "wal_segments": wal_segments,
+            "wal_bytes": wal_bytes,
+            "sealed_segments": sealed,
+            "oldest_pinned_seq": oldest,
+            "pinned_group": int(pinned_group),
+            "wal_pinned": bool(wal_pinned),
+            "wal_cuts": int(self.stats.get("wal_cuts", 0)),
+            "segments_released": int(
+                self.stats.get("wal_segments_released", 0)),
+            "snapshots_built": int(
+                self.stats.get("snapshots_built", 0)),
+            "snap_files": int(self._snap_file_count),
+            "snap_files_pruned": int(
+                self.stats.get("snap_files_pruned", 0)),
+            "snap_build_errors": int(
+                self.stats.get("snap_build_errors", 0)),
+        }
+        occ_now = int((self.rn.m_last - self.rn.m_snap).max())
+        if occ_now > self._ring_occ_hw:
+            self._ring_occ_hw = occ_now
+        ring = {
+            # Ring back-pressure: occupancy high-water vs the window,
+            # and how many proposals the typed ring_full refusal
+            # turned away before the device would have dropped them.
+            "window": int(self.cfg.window),
+            "occ_now": occ_now,
+            "occ_high_water": int(self._ring_occ_hw),
+            "full_refusals": int(
+                self.stats.get("ring_full_refusals", 0)),
+        }
         return {
             "wal_pipeline": wal_pipe,
+            "lifecycle": lifecycle,
+            "ring": ring,
             "fence_enabled": self.fence_enabled,
             # IO-error contract visibility (ISSUE 15): live ENOSPC
             # back-pressure, the fail-stop cause when a storage fault
@@ -1987,6 +2666,19 @@ class MultiRaftMember:
                             self._wm_term[group] = wt
                             self._wm_commit[group] = max(
                                 self._wm_commit[group], idx)
+                        # Install = durable snapshot cover too (the
+                        # full RT_SNAPSHOT record just fsync'd): WAL
+                        # segments below idx stop being needed for
+                        # this group. Evidence segment = the covering
+                        # fsync's tail (file bookkeeping untouched —
+                        # there is no FILE, and cadence measures
+                        # against the newest file, so a freshly
+                        # installed group builds one promptly).
+                        if idx >= int(self._snap_cover[group]):
+                            self._snap_cover[group] = idx
+                            self._snap_seq[group] = max(
+                                int(self._snap_seq[group]),
+                                int(self._last_sync_seq))
 
                     if self._wal_worker is not None:
                         # Pipeline mode: the records ride the open
@@ -2012,6 +2704,8 @@ class MultiRaftMember:
                                 for rt, d in records:
                                     self.wal.append(rt, d)
                                 self.wal.flush(sync=True)
+                                self._last_sync_seq = int(
+                                    self.wal.tail_seq())
                         except Exception as e:  # noqa: BLE001
                             # Storage fault mid-install (state already
                             # mutated): fail-stop — the install is
@@ -2053,6 +2747,14 @@ class MultiRaftMember:
         if self._disk_full:
             return False
         if not self.rn.is_leader(group):
+            return False
+        if self._ring_full(group):
+            # Typed ring back-pressure (the disk_full twin): the log
+            # ring has no headroom for another proposal this round —
+            # the device clamp would silently drop it. Refuse so the
+            # caller retries after compaction frees slots.
+            self.stats["ring_full_refusals"] = (
+                self.stats.get("ring_full_refusals", 0) + 1)
             return False
         self.rn.propose(group, payload)
         self._work.set()
@@ -2851,6 +3553,10 @@ class MultiRaftCluster:
                  disk_fault_hook_fn: Optional[
                      Callable[[int], Optional[Callable[[str, int],
                                                        None]]]] = None,
+                 snap_cadence: Optional[int] = None,
+                 snap_keep: int = SNAP_KEEP_DEFAULT,
+                 wal_rotate_bytes: Optional[int] = None,
+                 wal_pinned_segments: int = WAL_PINNED_SEGMENTS,
                  ) -> None:
         self.router = InProcRouter()
         self.members: Dict[int, MultiRaftMember] = {}
@@ -2861,6 +3567,10 @@ class MultiRaftCluster:
                 fence=fence, trace=trace, wal_pipeline=wal_pipeline,
                 wal_group_max_delay=wal_group_max_delay,
                 wal_group_max_bytes=wal_group_max_bytes,
+                # Log-lifecycle plane knobs (ISSUE 17).
+                snap_cadence=snap_cadence, snap_keep=snap_keep,
+                wal_rotate_bytes=wal_rotate_bytes,
+                wal_pinned_segments=wal_pinned_segments,
                 # Storage fault plane seam (ISSUE 15): a per-member
                 # hook factory, e.g. DiskFaultPlan.hook_for.
                 disk_fault_hook=(disk_fault_hook_fn(mid)

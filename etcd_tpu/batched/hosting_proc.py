@@ -423,7 +423,10 @@ def serve(member_id: int, num_members: int, num_groups: int,
           wal_pipeline: Optional[bool] = None,
           fabric: str = "tcp",
           shm_dir: Optional[str] = None,
-          pin_core: Optional[int] = None) -> None:
+          pin_core: Optional[int] = None,
+          snap_cadence: Optional[int] = None,
+          snap_keep: int = 2,
+          wal_rotate_bytes: Optional[int] = None) -> None:
     from .hosting import MultiRaftMember
     from .state import BatchedConfig
 
@@ -470,6 +473,13 @@ def serve(member_id: int, num_members: int, num_groups: int,
         # group-commit WAL pipeline — persistence decoupled from the
         # round cadence, acks released on fsync completion.
         wal_pipeline=wal_pipeline,
+        # --snap-cadence / --wal-rotate-bytes (ISSUE 17): log-lifecycle
+        # plane — cadence file snapshots, WAL segment rotation and
+        # fleet-min-gated release; admin 'health' reports the
+        # lifecycle/ring blocks, fleet_console renders them.
+        snap_cadence=snap_cadence,
+        snap_keep=snap_keep,
+        wal_rotate_bytes=wal_rotate_bytes,
     )
     if fabric == "shm":
         from .shmfabric import ShmFabric
@@ -539,6 +549,18 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="pin this member process to one CPU core "
                         "(sched_setaffinity) — one core per member "
                         "is the multi-core hosted-bench shape")
+    p.add_argument("--snap-cadence", type=int, default=None,
+                   help="build a file snapshot for a group every N "
+                        "applied entries (log-lifecycle plane; off by "
+                        "default — the WAL then grows unboundedly)")
+    p.add_argument("--snap-keep", type=int, default=2,
+                   help="snapshot files retained per group after each "
+                        "successful build (keep-K pruning)")
+    p.add_argument("--wal-rotate-bytes", type=int, default=None,
+                   help="cut the WAL tail segment past this many "
+                        "bytes and release sealed segments once every "
+                        "group's snapshot covers them (off by "
+                        "default)")
     a = p.parse_args(argv)
 
     def hp(s: str) -> Tuple[str, int]:
@@ -554,7 +576,9 @@ def main(argv: Optional[List[str]] = None) -> None:
           tick_interval=a.tick_interval, telemetry=a.telemetry,
           fleet=a.fleet, trace=a.trace or None,
           wal_pipeline=a.wal_pipeline or None,
-          fabric=a.fabric, shm_dir=a.shm_dir, pin_core=a.pin_core)
+          fabric=a.fabric, shm_dir=a.shm_dir, pin_core=a.pin_core,
+          snap_cadence=a.snap_cadence, snap_keep=a.snap_keep,
+          wal_rotate_bytes=a.wal_rotate_bytes)
 
 
 # -- client side ---------------------------------------------------------------

@@ -194,6 +194,13 @@ def invariant_bits(st, slot) -> jnp.ndarray:
         # outgoing voters would silently rejoin the electorate the
         # moment a later change re-enters joint.
         ~st.in_joint & jnp.any(st.voter_out),
+        # ring occupancy past the window: an append crossed the
+        # compaction floor and overwrote a live slot. The propose
+        # headroom clamp + the host-side ring_full refusal make this
+        # unreachable; a trip means log-lifecycle pressure accounting
+        # broke (wrap = silent log corruption, the worst failure the
+        # ring representation admits).
+        (st.last - st.snap_index) > st.log_term.shape[-1],
     ]
     bits = jnp.zeros((), I32)
     for i, b in enumerate(bad):

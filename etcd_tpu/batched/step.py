@@ -1783,11 +1783,21 @@ def _fleet_frame(cfg: BatchedConfig, pre: BatchedState,
     # negligible next to the top_k sort itself (k is 8, not G).
     top_lag, top_idx = jax.lax.top_k(backlog, k)
 
+    # Ring-pressure lane (log-lifecycle plane): occupancy is the live
+    # span of the device log ring — last minus the compaction floor.
+    # The histogram shows the fleet-wide distribution (how close rows
+    # run to the window W); the max is the member's high-water mark the
+    # console surfaces next to the ring_full refusal counter.
+    ring_occ = post.last - post.snap_index
+
     parts = {
         "hist_commit_delta": log_bucket_counts(delta, FLEET_BUCKETS),
         "hist_backlog": log_bucket_counts(backlog, FLEET_BUCKETS),
         "hist_inflight": log_bucket_counts_masked(
             post.inflight, FLEET_BUCKETS, lmask),
+        "hist_ring_occupancy": log_bucket_counts(
+            ring_occ, FLEET_BUCKETS),
+        "ring_occ_max": jnp.max(ring_occ)[None],
         "leader_slot": jnp.sum(
             ((slots[:, None] == peers[None, :]) & is_leader[:, None])
             .astype(I32), axis=0),

@@ -94,6 +94,10 @@ INV_NAMES = (
     "fenced_leader",        # durability-fenced instance became leader
     "voter_out_no_joint",   # outgoing-voter mask residue while the
     # row is not in a joint config (conf-apply lane inconsistency)
+    "ring_over_window",     # log-ring occupancy (last - snap_index)
+    # beyond the ring width W: an append crossed the compaction floor
+    # (wrap = silent log corruption; the ring_full back-pressure lane
+    # exists to make this unreachable)
 )
 
 

@@ -115,6 +115,8 @@ class FleetLayout:
             ("hist_commit_delta", b, ACC_SUM),
             ("hist_backlog", b, ACC_LAST),
             ("hist_inflight", b, ACC_LAST),
+            ("hist_ring_occupancy", b, ACC_LAST),
+            ("ring_occ_max", 1, ACC_LAST),
             ("leader_slot", r, ACC_LAST),
             ("role_census", len(ROLE_NAMES), ACC_LAST),
             ("pr_census", len(PR_STATE_NAMES), ACC_LAST),
@@ -209,8 +211,8 @@ def fleet_anomaly_counter(
     return reg.register(pmet.Counter(
         "etcd_tpu_fleet_anomalies_total",
         "fleet anomaly flags raised from device summary frames and "
-        "host persistence signals "
-        "(kind: commit_frozen | leader_skew | member_limping)",
+        "host persistence signals (kind: commit_frozen | leader_skew "
+        "| member_limping | wal_pinned)",
         ("member", "kind")))
 
 
@@ -234,6 +236,8 @@ def register_families(registry: Optional[pmet.Registry] = None) -> None:
                            "(device log buckets)"),
         ("inflight_depth", "leader-side tracked-peer inflight depth "
                            "(device log buckets)"),
+        ("ring_occupancy", "per-row log-ring occupancy last minus "
+                           "compaction floor (device log buckets)"),
     ):
         fleet_hist_family(name, help_, registry)
     fleet_gauge("leader_groups",
@@ -252,6 +256,10 @@ def register_families(registry: Optional[pmet.Registry] = None) -> None:
     fleet_gauge("term_spread", "max-min term spread across rows",
                 ("member",), registry)
     fleet_gauge("lag_max", "worst last-commit backlog across rows",
+                ("member",), registry)
+    fleet_gauge("ring_occ_max",
+                "worst log-ring occupancy across rows (vs window W; "
+                "the ring_full back-pressure high-water)",
                 ("member",), registry)
     fleet_gauge("leader_skew_ratio",
                 "max leaders-per-slot over the fair share G/R (x1000)",
@@ -319,6 +327,8 @@ class FleetHub:
                                             reg).labels(m)
         self._h_inflight = fleet_hist_family("inflight_depth", "",
                                              reg).labels(m)
+        self._h_ring_occ = fleet_hist_family("ring_occupancy", "",
+                                             reg).labels(m)
         self._g_leader = [
             fleet_gauge("leader_groups", "", ("member", "slot"),
                         reg).labels(m, str(s))
@@ -339,6 +349,8 @@ class FleetHub:
                                           ("member",), reg).labels(m)
         self._g_lag_max = fleet_gauge("lag_max", "", ("member",),
                                       reg).labels(m)
+        self._g_ring_occ_max = fleet_gauge("ring_occ_max", "",
+                                           ("member",), reg).labels(m)
         self._g_skew = fleet_gauge("leader_skew_ratio", "",
                                    ("member",), reg).labels(m)
         self._g_fsync_ewma = fleet_gauge("fsync_ewma_ms", "",
@@ -406,6 +418,8 @@ class FleetHub:
         self._fold_hist(self._h_delta, f["hist_commit_delta"])
         self._fold_hist(self._h_backlog, f["hist_backlog"])
         self._fold_hist(self._h_inflight, f["hist_inflight"])
+        self._fold_hist(self._h_ring_occ, f["hist_ring_occupancy"])
+        self._g_ring_occ_max.set(int(f["ring_occ_max"][0]))
         for s, g in enumerate(self._g_leader):
             g.set(int(f["leader_slot"][s]))
         for i, rn in enumerate(ROLE_NAMES):
@@ -480,6 +494,12 @@ class FleetHub:
         return out
 
     # -- anomaly flags --------------------------------------------------------
+
+    def raise_anomaly(self, kind: str, detail: Dict) -> None:
+        """Host-raised counted anomaly (the hosting layer's lifecycle
+        plane fires ``wal_pinned`` through this): same counter + log
+        as the frame-derived flags, so consoles see one stream."""
+        self._raise_anomaly(kind, detail)
 
     def _raise_anomaly(self, kind: str, detail: Dict) -> None:
         self._c_anom.labels(self.member, kind).inc()
@@ -591,6 +611,7 @@ class FleetHub:
                          "max": int(f["term_max"][0]),
                          "sum": int(f["term_sum"][0])},
                 "lag_max": int(f["top_lag"][0]),
+                "ring_occ_max": int(f["ring_occ_max"][0]),
                 "top": self._top_entries(f),
                 "hist": {
                     "commit_delta":
@@ -599,6 +620,8 @@ class FleetHub:
                         f["hist_backlog"].astype(int).tolist(),
                     "inflight":
                         f["hist_inflight"].astype(int).tolist(),
+                    "ring_occupancy":
+                        f["hist_ring_occupancy"].astype(int).tolist(),
                 },
             })
         return out

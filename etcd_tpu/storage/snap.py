@@ -117,6 +117,9 @@ class Snapshotter:
         # not the file's — ref: fileutil.Fsync after rename in the
         # reference's snap/wal paths; ATC'19's fsync-failure study
         # calls out exactly this class).
+        self._fsync_dir()
+
+    def _fsync_dir(self) -> None:
         dfd = os.open(self.dir, os.O_RDONLY)
         try:
             os.fsync(dfd)
@@ -171,11 +174,37 @@ class Snapshotter:
 
     def release_snap_dbs(self, index: int) -> None:
         """Delete snapshot files older than index (purge path,
-        ref: snapshotter.go ReleaseSnapDBs)."""
+        ref: snapshotter.go ReleaseSnapDBs). The unlink runs through
+        the fault seam (``snap_unlink``), and the directory is fsync'd
+        after pruning — the rename-fsync above makes CREATION durable,
+        but an unlink lives in the same directory pages: without this,
+        a crash can resurrect a pruned file and a later replay may pick
+        a stale snapshot that the retention contract promised was gone."""
+        removed = 0
         for name in self.snap_names():
             try:
                 idx = int(name[17:33], 16)
             except ValueError:
                 continue
             if idx < index:
+                self._hook("snap_unlink")
                 os.remove(os.path.join(self.dir, name))
+                removed += 1
+        if removed:
+            self._hook("snap_fsync")
+            self._fsync_dir()
+
+    def retain(self, keep: int) -> int:
+        """Keep the ``keep`` newest snapshot files, unlink the rest
+        (fault seam + dir fsync like release_snap_dbs). Returns the
+        number pruned. keep < 1 is clamped to 1 — retention must never
+        delete the only recoverable snapshot."""
+        keep = max(1, int(keep))
+        victims = self.snap_names()[keep:]
+        for name in victims:
+            self._hook("snap_unlink")
+            os.remove(os.path.join(self.dir, name))
+        if victims:
+            self._hook("snap_fsync")
+            self._fsync_dir()
+        return len(victims)

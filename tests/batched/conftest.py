@@ -57,6 +57,14 @@ os.environ.setdefault("ETCD_TPU_TRANSFER_GUARD", "disallow")
 # differential trio's exact config values (zero cost), and the
 # non-default chaos cells are slow-marked (outside tier-1). Budget
 # 41 → 43 keeps the same headroom of 2.
+#
+# ISSUE 17 AUDIT: still 43. test_lifecycle reuses test_chaos.CFG
+# VALUES verbatim (every lifecycle knob — snap_cadence, snap_keep,
+# wal_rotate_bytes, wal_pinned_segments — is a host-side member arg,
+# not a BatchedConfig field, so it never enters the compile key), and
+# the invariant-sweep ring_over_window bit + fleet-frame ring fields
+# changed layout VALUES inside existing programs, not program COUNT.
+# The G=1024 lifecycle soak config is slow-marked (outside tier-1).
 ROUND_STEP_SHAPE_BUDGET = 43
 
 

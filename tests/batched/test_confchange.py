@@ -350,6 +350,9 @@ class TestInvariantBit:
         st.commit = jnp.asarray(0, jnp.int32)
         st.last = jnp.asarray(0, jnp.int32)
         st.snap_index = jnp.asarray(0, jnp.int32)
+        # Bit 9 (ring_over_window) reads the ring window off the
+        # log_term lane shape.
+        st.log_term = jnp.zeros((4,), jnp.int32)
         st.read_ready = jnp.asarray(False)
         st.read_index = jnp.asarray(0, jnp.int32)
         slot = jnp.asarray(0, jnp.int32)

@@ -10,6 +10,8 @@
 # fsync-error fail-stop + ENOSPC back-pressure recover, zero acked
 # loss) + shmfabric smoke (ISSUE 16 mmap ring transport: 3-member shm
 # cluster, put wave, console transport column + shm metric families)
+# + lifecycle smoke (ISSUE 17 log-lifecycle plane: rotation, cadence
+# snapshots, fleet-min release, restart replay from snapshot files)
 # + bench-history re-emit. CI
 # runs exactly this script
 # (.github/workflows/lint.yml); run it locally before pushing anything
@@ -53,6 +55,9 @@ python tools/fused_smoke.py
 
 echo "== shmfabric smoke (3-member shm ring cluster, console transport column) =="
 python tools/shmfabric_smoke.py
+
+echo "== lifecycle smoke (WAL rotation -> cadence snapshot -> release -> replay) =="
+python tools/lifecycle_smoke.py
 
 echo "== bench history (artifacts/bench_history.json + BENCH_HISTORY.md) =="
 python tools/bench_history.py

@@ -135,6 +135,15 @@ def collect(addrs: List[str], timeout: float = 10.0,
                        if st.get("ok") else None),
             "fabric_lanes": ((st.get("fabric") or {}).get("lanes")
                              if st.get("ok") else None),
+            # Log-lifecycle plane (ISSUE 17): WAL segments + bytes on
+            # disk, the oldest still-pinned sealed segment and the
+            # group pinning it, snapshot-file census, and the ring
+            # back-pressure high-water from the health op. None when
+            # the member predates the fields, {"enabled": False, ...}
+            # when the plane is off (WAL grows unboundedly).
+            "lifecycle": (hl.get("lifecycle")
+                          if hl.get("ok") else None),
+            "ring": hl.get("ring") if hl.get("ok") else None,
         })
         members[mid] = ent
 
@@ -180,6 +189,18 @@ def collect(addrs: List[str], timeout: float = 10.0,
             if (m.get("limp") or {}).get("limping")),
         "failstop_members": sorted(
             m["member"] for m in live if m.get("fail_stop")),
+        # Log-lifecycle rollup (ISSUE 17): total WAL bytes on disk
+        # across members, and members whose sealed-segment backlog is
+        # pinned by a stuck/fenced group (the wal_pinned anomaly).
+        "wal_bytes_total": sum(
+            (m.get("lifecycle") or {}).get("wal_bytes", 0)
+            for m in live),
+        "snap_files_total": sum(
+            (m.get("lifecycle") or {}).get("snap_files", 0)
+            for m in live),
+        "wal_pinned_members": sorted(
+            m["member"] for m in live
+            if (m.get("lifecycle") or {}).get("wal_pinned")),
         "top": merged_top,
         "anomalies": anomalies,
     }
@@ -233,7 +254,8 @@ def render(data: Dict, top: int = 8) -> str:
         f"{'member':>8} {'frames':>8} {'leaders':>8} {'fenced':>7} "
         f"{'joint':>6} {'lrnr':>5} "
         f"{'lag max':>8} {'inv':>5} {'loss':>6} {'r/fsync':>8} "
-        f"{'fsync ms':>9} {'transport':>14}  wal tail / disk state",
+        f"{'fsync ms':>9} {'wal seg/MiB':>12} {'snaps':>6} "
+        f"{'ring hw':>8} {'transport':>14}  wal tail / disk state",
     ]
     for mid in sorted(data["members"]):
         m = data["members"][mid]
@@ -255,8 +277,22 @@ def render(data: Dict, top: int = 8) -> str:
             depth = max(v.get("depth", 0) for v in lanes.values())
             hw = max(v.get("high_water", 0) for v in lanes.values())
             fab = f"{fab} {depth // 1024}/{hw // 1024}K"
+        # Log-lifecycle columns: segments/MiB on disk, snapshot files,
+        # ring-occupancy high-water vs window. "-" when the plane is
+        # off or the member predates it.
+        lc = m.get("lifecycle") or {}
+        ring = m.get("ring") or {}
+        if lc.get("enabled"):
+            seg = (f"{lc.get('wal_segments', 0)}/"
+                   f"{lc.get('wal_bytes', 0) / (1 << 20):.1f}")
+            snaps = str(lc.get("snap_files", 0))
+        else:
+            seg, snaps = "-", "-"
+        ring_hw = (f"{ring.get('occ_high_water', 0)}/"
+                   f"{ring.get('window', 0)}" if ring else "-")
         # The disk-state tail: wal tail classification, plus any live
-        # fault-plane condition (limping / disk_full / fail-stop).
+        # fault-plane condition (limping / disk_full / fail-stop /
+        # a pinned WAL backlog and the group pinning it).
         disk = str(m["wal_tail"])
         if limp.get("limping"):
             disk += " LIMPING"
@@ -264,12 +300,16 @@ def render(data: Dict, top: int = 8) -> str:
             disk += " DISK_FULL"
         if m.get("fail_stop"):
             disk += f" FAILSTOP({m['fail_stop']})"
+        if lc.get("wal_pinned"):
+            disk += (f" WAL_PINNED(g{lc.get('pinned_group')}"
+                     f"@seq{lc.get('oldest_pinned_seq')})")
         lines.append(
             f"{m['member']:>8} {m['frames']:>8} {m['leaders']:>8} "
             f"{m['fenced']:>7} {str(m.get('joint')):>6} "
             f"{str(m.get('learners')):>5} {m['lag_max']:>8} "
             f"{str(m['invariant_trips']):>5} "
             f"{str(m['router_loss']):>6} {rpf:>8} {fsync_ms:>9} "
+            f"{seg:>12} {snaps:>6} {ring_hw:>8} "
             f"{fab:>14}  {disk}")
     lines.append("")
     lines.append(f"top-{top} laggards (cluster-wide):")

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Log-lifecycle smoke for tools/check.sh (ISSUE 17): a tiny in-proc
+cluster runs with aggressive lifecycle knobs (snapshot every 2 applied
+entries, rotate the WAL tail past ~1 KiB), pumps writes until every
+member has cut a segment, built a cadence file snapshot AND released a
+sealed segment, checks retention (never more than snap_keep files per
+group dir), then stops and cold-restarts: the replay must come back
+through the file snapshots + the rotated tail with every acked write
+served. One tiny compile (~seconds on CPU); a rotation, release-gating
+or marker-replay regression fails the static gate, not a hosted run.
+
+Writes artifacts/lifecycle_smoke.json (uploaded by lint.yml on
+failure).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from etcd_tpu.batched.hosting import MultiRaftCluster  # noqa: E402
+from etcd_tpu.batched.state import BatchedConfig  # noqa: E402
+
+G, R = 4, 3
+SNAP_CADENCE = 2
+ROTATE_BYTES = 1024
+
+OUT = os.path.join("artifacts", "lifecycle_smoke.json")
+
+
+def _fail(report, msg: str) -> int:
+    """Report the failure INTO the artifact too: lint.yml uploads it
+    under if: failure(), so the forensics must reflect the failing
+    run, not a stale prior success."""
+    report["ok"] = False
+    report["error"] = msg
+    _write(report)
+    print(f"lifecycle smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def _write(report) -> None:
+    os.makedirs("artifacts", exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+
+
+def main() -> int:
+    cfg = BatchedConfig(
+        num_groups=G, num_replicas=R, window=8, max_ents_per_msg=2,
+        max_props_per_round=2, election_timeout=10,
+        heartbeat_timeout=1, pre_vote=True, check_quorum=True,
+        auto_compact=True,
+    )
+    data_dir = tempfile.mkdtemp(prefix="lifecycle-smoke-")
+    report = {"groups": G, "members": R, "ok": False,
+              "snap_cadence": SNAP_CADENCE,
+              "wal_rotate_bytes": ROTATE_BYTES}
+    written = {}
+    c = MultiRaftCluster(data_dir, num_members=R, num_groups=G,
+                         cfg=cfg, snap_cadence=SNAP_CADENCE,
+                         wal_rotate_bytes=ROTATE_BYTES)
+    try:
+        c.wait_leaders(timeout=120.0)
+
+        def lifecycle_done() -> bool:
+            for m in c.members.values():
+                lc = m.health()["lifecycle"]
+                if not (lc["wal_cuts"] > 0
+                        and lc["snapshots_built"] > 0
+                        and lc["segments_released"] > 0):
+                    return False
+            return True
+
+        # Pump acked writes until the full cut -> snapshot -> release
+        # loop has turned over on every member.
+        deadline = time.monotonic() + 120.0
+        i = 0
+        while not lifecycle_done():
+            if time.monotonic() > deadline:
+                return _fail(report, "lifecycle loop never completed: "
+                             + json.dumps({
+                                 str(m.id): m.health()["lifecycle"]
+                                 for m in c.members.values()}))
+            for g in range(G):
+                k, v = b"k%d" % i, b"g%d-v%d" % (g, i)
+                c.put(g, k, v, timeout=30.0)
+                written[(g, k)] = v
+            i += 1
+        report["put_passes"] = i
+        report["lifecycle"] = {
+            str(m.id): m.health()["lifecycle"]
+            for m in c.members.values()}
+
+        # Retention: never more than snap_keep .snap files per group.
+        for m in c.members.values():
+            snap_root = os.path.join(m.dir, "snap")
+            if not os.path.isdir(snap_root):
+                return _fail(report,
+                             f"member {m.id} built no snapshot dirs")
+            for sub in sorted(os.listdir(snap_root)):
+                files = [n for n in
+                         os.listdir(os.path.join(snap_root, sub))
+                         if n.endswith(".snap")]
+                if len(files) > m.snap_keep:
+                    return _fail(
+                        report,
+                        f"retention leak: member {m.id} {sub} holds "
+                        f"{files}")
+    finally:
+        c.stop()
+
+    # Cold restart: replay comes back through file snapshots + the
+    # rotated tail; every acked write must be served again.
+    c2 = MultiRaftCluster(data_dir, num_members=R, num_groups=G,
+                          cfg=cfg, snap_cadence=SNAP_CADENCE,
+                          wal_rotate_bytes=ROTATE_BYTES)
+    try:
+        for m in c2.members.values():
+            if int(m._snap_file_idx.max()) <= 0:
+                return _fail(
+                    report,
+                    f"member {m.id} replay found no file snapshots "
+                    "despite fsync'd markers")
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if all(m.get(g, k) == v
+                   for m in c2.members.values()
+                   for (g, k), v in written.items()):
+                break
+            time.sleep(0.05)
+        else:
+            return _fail(report,
+                         "acked writes lost across stop+replay")
+        report["replay"] = {
+            str(m.id): {
+                "snap_file_idx_max": int(m._snap_file_idx.max()),
+                "wal_segments":
+                    m.health()["lifecycle"]["wal_segments"],
+            } for m in c2.members.values()}
+    finally:
+        c2.stop()
+
+    report["ok"] = True
+    _write(report)
+    rel = {k: v["segments_released"]
+           for k, v in report["lifecycle"].items()}
+    print(f"lifecycle smoke OK: released segments per member {rel}, "
+          f"replay from snapshots clean ({OUT})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
