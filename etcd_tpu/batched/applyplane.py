@@ -1,0 +1,382 @@
+"""Device-resident apply plane: tensorized MVCC, watch matching, and
+lease TTL expiry (ROADMAP item 5; PAPER.md layer map L2 — ``mvcc.KV``/
+``WatchableKV``/``lease.Lessor`` as device tensors riding the round).
+
+The plane is a SEPARATE jitted program from the round step: the round
+decides *what committed*; this program folds those commits into a
+fixed-capacity per-group KV/revision store without the per-entry host
+Python loop (``hosting.py`` ``kvs[row].apply``). One dispatch applies up
+to ``A = cfg.apply_records`` committed entries per group row — a round
+that commits more redispatches the SAME compiled program, so the shape
+set stays static (its own ``apply_plane`` compile-key kind; the
+round-step budget in tests/batched/conftest.py never moves).
+
+Byte honesty (SURVEY §7: payload bytes don't belong on the TPU): the
+device store holds 31-bit FNV-1a key/value *hashes* and i32 revision /
+lease-expiry lanes — the MVCC metadata. Byte truth stays in the host
+``GroupKV`` tier, which keeps applying every payload (shadow/overflow
+tier): lease-hit reads serve bytes from the host tier after the device
+lane authorizes them, and rows whose live keys exceed ``C =
+cfg.apply_capacity`` set a sticky overflow flag routing that row's
+reads/snapshot-capture back to the host tier.
+
+Semantics of one dispatch (the oracle below replays them exactly):
+
+1. ``tick += tick_add`` (the member's staged round-tick count — the
+   plane clock is per-member host time, like the lease lane).
+2. Expiry pass: every slot with ``0 < kv_lease <= tick`` is cleared;
+   the group revision advances by the number of expired slots.
+3. Apply scan over the A record lanes in order. put: exact-hash match
+   updates the slot, else first-free-slot insert, else sticky
+   ``overflow``; revision always advances. delete: clears the matching
+   slot and advances the revision only if the key existed (a delete of
+   a missing key is a no-op, matching the host tier's ``pop``).
+   Each applied record's watch bitmap is the OR of exact-key matches
+   against the armed watch slots (``WS <= 32`` packs into one i32).
+
+Client lease TTLs ride a third payload form (``E`` = expiring put; the
+host tier stores the bytes and ignores the TTL — expiry is leader-local
+visibility, faithful to etcd's leader-driven lessor, and keeping the
+host bytes untouched keeps the cross-member KV-hash parity checker
+meaningful).
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.sentinels import note_compile_key
+from .state import BatchedConfig, I32
+
+# Record opcodes (the host-built apply stream).
+OP_NONE, OP_PUT, OP_DEL = 0, 1, 2
+
+
+# -----------------------------------------------------------------------------
+# Host-side hashing + payload forms
+# -----------------------------------------------------------------------------
+
+
+def fnv1a32(data: bytes) -> int:
+    """31-bit nonzero FNV-1a — the plane's key/value identity. Masked
+    to 31 bits so it stays positive in i32 lanes; 0 is reserved for
+    'empty slot', so a zero digest maps to 1."""
+    h = 2166136261
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    h &= 0x7FFFFFFF
+    return h or 1
+
+
+def put_payload(key: bytes, value: bytes, lease_ttl: int = 0) -> bytes:
+    """Proposal payload for a put; ``lease_ttl`` > 0 (plane ticks)
+    makes it an expiring put (payload form ``E``). The non-lease forms
+    are byte-identical to GroupKV's (``P``/``D``) — every pre-plane
+    WAL/snapshot stays replayable."""
+    if lease_ttl > 0:
+        return b"E" + struct.pack(">I", lease_ttl) + key + b"\x00" + value
+    return b"P" + key + b"\x00" + value
+
+
+def delete_payload(key: bytes) -> bytes:
+    return b"D" + key
+
+
+def parse_payload(d: bytes) -> Optional[Tuple[int, bytes, bytes, int]]:
+    """(op, key, value, lease_ttl) of a KV payload; None for payloads
+    the KV tier ignores (conf entries never reach here — the rawnode
+    splits on etype first)."""
+    if not d:
+        return None
+    tag = d[:1]
+    if tag == b"P":
+        k, _, v = d[1:].partition(b"\x00")
+        return (OP_PUT, k, v, 0)
+    if tag == b"E":
+        if len(d) < 5:
+            return None
+        (ttl,) = struct.unpack(">I", d[1:5])
+        k, _, v = d[5:].partition(b"\x00")
+        return (OP_PUT, k, v, int(ttl))
+    if tag == b"D":
+        return (OP_DEL, d[1:], b"", 0)
+    return None
+
+
+# -----------------------------------------------------------------------------
+# Device state + frames
+# -----------------------------------------------------------------------------
+
+
+class PlaneState(NamedTuple):
+    """Per-row (row = group on the hosting path) MVCC tensors."""
+
+    kv_key: jnp.ndarray  # [n, C] i32 key hash; 0 = empty slot
+    kv_rev: jnp.ndarray  # [n, C] i32 mod-revision of the slot
+    kv_val: jnp.ndarray  # [n, C] i32 value hash
+    kv_lease: jnp.ndarray  # [n, C] i32 expiry tick; 0 = no lease
+    watch_key: jnp.ndarray  # [n, WS] i32 armed exact-key watches; 0 = off
+    rev: jnp.ndarray  # [n] i32 group revision counter
+    tick: jnp.ndarray  # [n] i32 plane clock (staged round ticks)
+    overflow: jnp.ndarray  # [n] bool sticky capacity overflow
+    slots_hw: jnp.ndarray  # [n] i32 used-slot high-water
+
+
+class PlaneFrame(NamedTuple):
+    """Fixed-shape per-dispatch output the host drains: the watch event
+    lanes (the SummaryFrame pattern generalized to the apply stream)
+    plus per-row counters."""
+
+    ev_op: jnp.ndarray  # [n, A] i32 applied opcode (0 = empty lane)
+    ev_key: jnp.ndarray  # [n, A] i32 key hash of the applied record
+    ev_rev: jnp.ndarray  # [n, A] i32 revision assigned (0 = none)
+    ev_wmask: jnp.ndarray  # [n, A] i32 watch-slot match bitmap
+    puts: jnp.ndarray  # [n] i32
+    dels: jnp.ndarray  # [n] i32
+    expired: jnp.ndarray  # [n] i32 lease expiries this dispatch
+    slots_used: jnp.ndarray  # [n] i32 live slots after the dispatch
+    leases: jnp.ndarray  # [n] i32 slots holding an unexpired lease
+    overflow: jnp.ndarray  # [n] bool (post-dispatch sticky flag)
+
+
+def init_plane(cfg: BatchedConfig, n: int) -> PlaneState:
+    c, ws = cfg.apply_capacity, cfg.apply_watch_slots
+    return PlaneState(
+        kv_key=jnp.zeros((n, c), I32),
+        kv_rev=jnp.zeros((n, c), I32),
+        kv_val=jnp.zeros((n, c), I32),
+        kv_lease=jnp.zeros((n, c), I32),
+        watch_key=jnp.zeros((n, ws), I32),
+        rev=jnp.zeros((n,), I32),
+        tick=jnp.zeros((n,), I32),
+        overflow=jnp.zeros((n,), bool),
+        slots_hw=jnp.zeros((n,), I32),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _dispatch_jit(c: int, ws: int, a: int, n: int):
+    """One compiled apply program per (capacity, watch slots, records,
+    rows) — its own compile-key kind, so the round-step shape budget is
+    structurally untouched."""
+    note_compile_key("apply_plane", f"C={c}|WS={ws}|A={a}|n={n}")
+
+    def per_row(kv_key, kv_rev, kv_val, kv_lease, watch_key, rev, tick,
+                overflow, ops, keys, vals, ttls, tick_add):
+        tick = tick + tick_add
+        # --- expiry pass (before new records: a put in this dispatch
+        # re-arms its key AFTER the old lease's deadline fires) --------
+        dead = (kv_lease > 0) & (kv_lease <= tick)
+        n_dead = jnp.sum(dead.astype(I32))
+        kv_key = jnp.where(dead, 0, kv_key)
+        kv_rev = jnp.where(dead, 0, kv_rev)
+        kv_val = jnp.where(dead, 0, kv_val)
+        kv_lease = jnp.where(dead, 0, kv_lease)
+        rev = rev + n_dead
+
+        # --- apply scan over the A record lanes in order --------------
+        def apply_one(carry, rec):
+            kv_key, kv_rev, kv_val, kv_lease, rev, overflow = carry
+            op, key, val, ttl = rec
+            hit = kv_key == key
+            exists = jnp.any(hit)
+            free = kv_key == 0
+            # First free slot: argmax over bool finds the first True.
+            ins = jnp.argmax(free)
+            has_free = jnp.any(free)
+            slot = jnp.where(exists, jnp.argmax(hit), ins)
+            slot_ok = exists | has_free
+            is_put = op == OP_PUT
+            is_del = op == OP_DEL
+            # put: revision always advances (the store of record even
+            # when the row overflows — the host tier holds the bytes);
+            # del: only if the key existed.
+            bump = is_put | (is_del & exists)
+            new_rev = rev + jnp.where(bump, 1, 0)
+            onehot = (jnp.arange(c, dtype=I32) == slot) & slot_ok
+            wr_put = is_put & slot_ok
+            kv_key = jnp.where(wr_put & onehot, key, kv_key)
+            kv_val = jnp.where(wr_put & onehot, val, kv_val)
+            kv_rev = jnp.where(wr_put & onehot, new_rev, kv_rev)
+            kv_lease = jnp.where(
+                wr_put & onehot,
+                jnp.where(ttl > 0, tick + ttl, 0), kv_lease)
+            wr_del = is_del & exists
+            kv_key = jnp.where(wr_del & onehot, 0, kv_key)
+            kv_val = jnp.where(wr_del & onehot, 0, kv_val)
+            kv_rev = jnp.where(wr_del & onehot, 0, kv_rev)
+            kv_lease = jnp.where(wr_del & onehot, 0, kv_lease)
+            overflow = overflow | (is_put & ~slot_ok)
+            wmask = jnp.sum(
+                jnp.where(
+                    (watch_key == key) & (key != 0),
+                    jnp.left_shift(
+                        jnp.ones((ws,), I32), jnp.arange(ws, dtype=I32)),
+                    0))
+            ev = (op, key, jnp.where(bump, new_rev, 0),
+                  jnp.where(op != OP_NONE, wmask, 0))
+            return (kv_key, kv_rev, kv_val, kv_lease, new_rev,
+                    overflow), ev
+
+        (kv_key, kv_rev, kv_val, kv_lease, rev, overflow), evs = (
+            jax.lax.scan(
+                apply_one,
+                (kv_key, kv_rev, kv_val, kv_lease, rev, overflow),
+                (ops, keys, vals, ttls)))
+        used = jnp.sum((kv_key != 0).astype(I32))
+        return (
+            (kv_key, kv_rev, kv_val, kv_lease, rev, tick, overflow,
+             used),
+            evs,
+            (jnp.sum((ops == OP_PUT).astype(I32)),
+             jnp.sum((ops == OP_DEL).astype(I32)), n_dead, used,
+             jnp.sum((kv_lease > 0).astype(I32))),
+        )
+
+    def dispatch(plane: PlaneState, ops, keys, vals, ttls, tick_add):
+        rows, evs, counts = jax.vmap(
+            per_row, in_axes=(0,) * 8 + (0, 0, 0, 0, 0),
+        )(plane.kv_key, plane.kv_rev, plane.kv_val, plane.kv_lease,
+          plane.watch_key, plane.rev, plane.tick, plane.overflow,
+          ops, keys, vals, ttls, tick_add)
+        (kv_key, kv_rev, kv_val, kv_lease, rev, tick, overflow,
+         used) = rows
+        plane2 = PlaneState(
+            kv_key=kv_key, kv_rev=kv_rev, kv_val=kv_val,
+            kv_lease=kv_lease, watch_key=plane.watch_key, rev=rev,
+            tick=tick, overflow=overflow,
+            slots_hw=jnp.maximum(plane.slots_hw, used))
+        frame = PlaneFrame(
+            ev_op=evs[0], ev_key=evs[1], ev_rev=evs[2],
+            ev_wmask=evs[3], puts=counts[0], dels=counts[1],
+            expired=counts[2], slots_used=counts[3], leases=counts[4],
+            overflow=overflow)
+        return plane2, frame
+
+    # Donate the plane carry: its buffers are always jax-native (built
+    # by init_plane / the previous dispatch), never host-aliased like
+    # the round's staged inbox, so XLA reuses the SoA KV buffers
+    # in place between dispatches.
+    return jax.jit(dispatch, donate_argnums=(0,))
+
+
+def make_dispatch(cfg: BatchedConfig, n: int):
+    """dispatch(plane, ops, keys, vals, ttls, tick_add) ->
+    (plane', PlaneFrame); all [n, A] i32 record lanes + [n] tick_add."""
+    return _dispatch_jit(
+        cfg.apply_capacity, cfg.apply_watch_slots, cfg.apply_records, n)
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_jit(m: int):
+    """Sliced snapshot-capture gather (satellite: _build_snapshots must
+    not walk host dicts per group): ONE device gather per build batch,
+    rows padded host-side to the member's fixed build cap so the shape
+    set stays static."""
+    note_compile_key("apply_plane", f"gather|m={m}")
+
+    def gather(plane: PlaneState, rows):
+        take = lambda x: jnp.take(x, rows, axis=0)  # noqa: E731
+        return (take(plane.kv_key), take(plane.kv_rev),
+                take(plane.kv_val), take(plane.kv_lease),
+                take(plane.rev), take(plane.tick), take(plane.overflow))
+
+    return jax.jit(gather)
+
+
+def gather_rows(plane: PlaneState, rows: np.ndarray):
+    """Device-side batched row gather for snapshot capture; ``rows`` is
+    a fixed-width padded i32 vector (pad with row 0; the host slices)."""
+    return _gather_jit(int(rows.shape[0]))(plane, jnp.asarray(rows, I32))
+
+
+# -----------------------------------------------------------------------------
+# Host-side shadow oracle (tests + smoke reconcile against this, and
+# this against the device — exact, not statistical)
+# -----------------------------------------------------------------------------
+
+
+class PlaneOracle:
+    """Pure-Python replay of one row's dispatch semantics. Feeding it
+    the exact (records, tick_add) stream a member staged must reproduce
+    the device tensors bit-for-bit (tests/batched/test_applyplane.py)."""
+
+    def __init__(self, cfg: BatchedConfig):
+        self.c = cfg.apply_capacity
+        self.ws = cfg.apply_watch_slots
+        self.kv_key = [0] * self.c
+        self.kv_rev = [0] * self.c
+        self.kv_val = [0] * self.c
+        self.kv_lease = [0] * self.c
+        self.watch_key = [0] * self.ws
+        self.rev = 0
+        self.tick = 0
+        self.overflow = False
+        self.slots_hw = 0
+        self.events: List[Tuple[int, int, int, int]] = []
+        self.expired = 0
+
+    def dispatch(self, records: List[Tuple[int, int, int, int]],
+                 tick_add: int) -> None:
+        """records: [(op, key_hash, val_hash, ttl)] (<= A per call the
+        way the rawnode chunks them, but the oracle takes any length —
+        chunking cannot change the fold)."""
+        self.tick += tick_add
+        for s in range(self.c):
+            if 0 < self.kv_lease[s] <= self.tick:
+                self.kv_key[s] = self.kv_rev[s] = 0
+                self.kv_val[s] = self.kv_lease[s] = 0
+                self.rev += 1
+                self.expired += 1
+        for op, key, val, ttl in records:
+            if op == OP_NONE:
+                continue
+            slot = next(
+                (s for s in range(self.c) if self.kv_key[s] == key),
+                None)
+            if op == OP_PUT:
+                self.rev += 1
+                if slot is None:
+                    slot = next(
+                        (s for s in range(self.c)
+                         if self.kv_key[s] == 0), None)
+                if slot is None:
+                    self.overflow = True
+                else:
+                    self.kv_key[slot] = key
+                    self.kv_val[slot] = val
+                    self.kv_rev[slot] = self.rev
+                    self.kv_lease[slot] = (
+                        self.tick + ttl if ttl > 0 else 0)
+                ev_rev = self.rev
+            else:  # OP_DEL
+                if slot is not None:
+                    self.rev += 1
+                    self.kv_key[slot] = self.kv_rev[slot] = 0
+                    self.kv_val[slot] = self.kv_lease[slot] = 0
+                    ev_rev = self.rev
+                else:
+                    ev_rev = 0
+            wmask = 0
+            if key != 0:
+                for w in range(self.ws):
+                    if self.watch_key[w] == key:
+                        wmask |= 1 << w
+            self.events.append((op, key, ev_rev, wmask))
+        self.slots_hw = max(
+            self.slots_hw, sum(1 for k in self.kv_key if k != 0))
+
+    def state(self) -> Dict[str, object]:
+        return {
+            "kv_key": list(self.kv_key), "kv_rev": list(self.kv_rev),
+            "kv_val": list(self.kv_val),
+            "kv_lease": list(self.kv_lease),
+            "rev": self.rev, "tick": self.tick,
+            "overflow": self.overflow, "slots_hw": self.slots_hw,
+        }

@@ -330,6 +330,16 @@ class GroupKV:
         if op == b"P":
             k, v = rest.split(b"\x00", 1)
             self.data[k] = v
+        elif op == b"E":
+            # Expiring put (apply-plane lease form, applyplane.py:
+            # u32be TTL then the P layout). The host tier stores the
+            # bytes and ignores the TTL — expiry visibility is
+            # leader-local (the device lessor masks lease reads, ref:
+            # etcd's leader-driven lessor), so the replicated byte
+            # state stays identical across members with or without
+            # the plane.
+            k, v = rest[4:].split(b"\x00", 1)
+            self.data[k] = v
         elif op == b"D":
             self.data.pop(rest, None)
 
@@ -351,6 +361,23 @@ class GroupKV:
     @staticmethod
     def delete_payload(key: bytes) -> bytes:
         return b"D" + key
+
+
+def _split_snap_blob(blob: bytes):
+    """Decode a snapshot app blob in either on-disk/wire format: the
+    legacy host-tier dump (a flat hex dict) or the two-tier apply-plane
+    wrapper ({"host": ..., "plane": ...}). Returns (host key->value
+    dict, plane image dict or None)."""
+    if not blob:
+        return {}, None
+    d = json.loads(blob.decode())
+    img = None
+    if "host" in d and "plane" in d:
+        img = d["plane"]
+        d = d["host"]
+    return {
+        bytes.fromhex(k): bytes.fromhex(v) for k, v in d.items()
+    }, img
 
 
 class MultiRaftMember:
@@ -400,6 +427,22 @@ class MultiRaftMember:
         os.makedirs(self.dir, exist_ok=True)
         self.kvs = [GroupKV() for _ in range(num_groups)]
         self.applied_index = np.zeros(num_groups, np.int64)
+        # Device apply plane (ISSUE 19): with cfg.apply_plane the host
+        # KV above becomes the shadow/overflow BYTE tier (the device
+        # stores 31-bit key/value hashes + revision/lease lanes);
+        # linearizable reads route lease-first (linearizable_get) and
+        # snapshot capture gathers the device tensors. _boot_plane
+        # stashes per-group plane images decoded during _replay (the
+        # rawnode does not exist yet there) for post-boot staging.
+        self._boot_plane: Dict[int, Dict] = {}
+        # Groups with a leadership transfer staged on this member:
+        # lease reads refuse until the device round zeroes the lease
+        # lane (MsgTimeoutNow lets the target campaign without waiting
+        # an election timeout, so the tick-silence safety argument
+        # does not cover the staging window).
+        self._lease_block: set = set()
+        self._watch_next = np.zeros(num_groups, np.int64)
+        self._watches: Dict[Tuple[int, int], bytes] = {}
         self._send = send_fn  # set by the router/transport
         # Block fast path (SoA frames, see msgblock.py); routers that
         # support it set this, others get the object fallback.
@@ -605,12 +648,51 @@ class MultiRaftMember:
                 for p in ("round", "wal", "apply", "send",
                           "stage", "extract", "collect")
             }
+        # Apply-plane metric children (telemetry + plane both on):
+        # gauges fold from rawnode.plane_stats on the apply path, the
+        # read counter moves inline in linearizable_get.
+        self._m_ap_slots = self._m_ap_leases = None
+        self._m_ap_overflow = self._m_ap_watch = None
+        self._m_ap_hit = self._m_ap_fb = None
+        self._ap_we_prev = 0
+        if self.cfg.telemetry and self.cfg.apply_plane:
+            from .telemetry import (
+                apply_plane_leases_gauge,
+                apply_plane_overflow_gauge,
+                apply_plane_reads_counter,
+                apply_plane_slots_gauge,
+                apply_plane_watch_events_counter,
+            )
+
+            mid = str(member_id)
+            self._m_ap_slots = apply_plane_slots_gauge().labels(mid)
+            self._m_ap_leases = apply_plane_leases_gauge().labels(mid)
+            self._m_ap_overflow = (
+                apply_plane_overflow_gauge().labels(mid))
+            self._m_ap_watch = (
+                apply_plane_watch_events_counter().labels(mid))
+            rc = apply_plane_reads_counter()
+            self._m_ap_hit = rc.labels(mid, "lease_hit")
+            self._m_ap_fb = rc.labels(mid, "readindex_fallback")
         if restore:
             for row, rr in restore.items():
                 self.applied_index[row] = rr.applied
                 # Re-apply WAL tail beyond the app snapshot: committed
                 # entries land again via the first Ready (applied mirror
                 # starts at the snapshot index).
+            if self.rn.plane is not None:
+                # Seed the device plane rows: the stashed two-tier
+                # image where the snapshot carried one (exact — its
+                # applied watermark makes the tail re-dispatch
+                # idempotent), else a rebuild from the host byte tier
+                # (legacy blob: revisions renumbered, leases dropped —
+                # the documented contract, see README).
+                for row, rr in restore.items():
+                    img = self._boot_plane.get(row)
+                    if img is not None:
+                        self._plane_restore_img(row, img)
+                    elif self.kvs[row].data or rr.applied:
+                        self._plane_seed_from_host(row, int(rr.applied))
         wal_dir = os.path.join(self.dir, "wal")
         fresh = not (
             os.path.isdir(wal_dir)
@@ -841,7 +923,13 @@ class MultiRaftMember:
         for g in set(rows) | set(ents) | set(snaps):
             rr = rows[g]
             si, st_, sd = snaps.get(g, (0, 0, b""))
-            self.kvs[g].restore(sd)
+            # Format-aware host restore (the RT_SNAPSHOT record holds
+            # the two-tier wrapper when the plane was on); the plane
+            # image is stashed for staging once the rawnode exists.
+            host_data, plane_img = _split_snap_blob(sd)
+            self.kvs[g].data = host_data
+            if plane_img is not None:
+                self._boot_plane[g] = plane_img
             rr.snap_index, rr.snap_term = si, st_
             rr.applied = si
             rr.entries = [e for e in ents.get(g, []) if e[0] > si]
@@ -1527,6 +1615,120 @@ class MultiRaftMember:
                 "term": self._snap_file_term[covered]})))
         return recs
 
+    # -- device apply plane (ISSUE 19) -----------------------------------------
+
+    def _snap_data_many(self, rows) -> List[bytes]:
+        """App-state blobs for a batch of groups (caller holds _lock).
+        Plane off: the host tier's JSON dump, byte-identical to the
+        pre-plane wire/disk format. Plane on: the two-tier wrapper —
+        host bytes at the apply watermark plus the device plane image
+        captured by ONE padded gather for the whole batch (the capture
+        seam: a host dict walk per group inside _lock does not survive
+        growing G)."""
+        rows = [int(g) for g in rows]
+        if self.rn.plane is None:
+            return [self.kvs[g].snapshot() for g in rows]
+        imgs = self.rn.plane_capture(rows)
+        return [json.dumps({
+            "host": {k.hex(): v.hex()
+                     for k, v in self.kvs[g].data.items()},
+            "plane": img,
+        }).encode() for g, img in zip(rows, imgs)]
+
+    def _restore_data(self, row: int, blob: bytes, idx: int) -> None:
+        """Install snapshot app state for one group (caller holds
+        _lock): host byte tier always; with the plane on, the device
+        row image is staged too — from the blob's plane section, or
+        rebuilt from the host dict when a plane-off sender shipped a
+        legacy blob."""
+        data, img = _split_snap_blob(blob)
+        self.kvs[row].data = data
+        if self.rn.plane is None:
+            return
+        if img is not None:
+            self._plane_restore_img(row, img)
+        else:
+            self._plane_seed_from_host(row, idx)
+
+    def _plane_restore_img(self, row: int, img: Dict) -> None:
+        self.rn.plane_restore_row(
+            row, img["kv_key"], img["kv_rev"], img["kv_val"],
+            img["kv_lease"], img["rev"], img["tick"],
+            img["overflow"], img.get("applied", 0),
+            [(bytes.fromhex(k), int(e))
+             for k, e in img.get("lessor", [])])
+
+    def _plane_seed_from_host(self, row: int, applied: int) -> None:
+        """Rebuild a plane row from the host byte tier (legacy blob or
+        plane-off sender): revisions renumbered 1..k in key order,
+        leases dropped — the documented legacy-restore contract."""
+        from .applyplane import fnv1a32
+
+        c = self.cfg.apply_capacity
+        kk, kr, kv = [0] * c, [0] * c, [0] * c
+        rev = slot = 0
+        over = False
+        data = self.kvs[row].data
+        for k in sorted(data):
+            rev += 1
+            if slot >= c:
+                over = True
+                continue
+            kk[slot] = fnv1a32(k)
+            kr[slot] = rev
+            kv[slot] = fnv1a32(data[k])
+            slot += 1
+        self.rn.plane_restore_row(row, kk, kr, kv, [0] * c, rev, 0,
+                                  over, applied, [])
+
+    def _lease_masked_get(self, group: int, key: bytes):
+        """Host-tier byte read masked by the lessor mirror: a key whose
+        lease expired on the device plane clock reads as absent even
+        though the byte tier still holds it (expiry is leader-local —
+        the replicated byte state never forks)."""
+        exp = self.rn.plane_lessor.get((group, bytes(key)))
+        if exp is not None and exp <= int(self.rn.m_plane_tick[group]):
+            return None
+        return self.kvs[group].data.get(key)
+
+    def watch(self, group: int, key: bytes) -> int:
+        """Arm an exact-key watch on `group`; returns the watch slot.
+        Matching runs as masked compares on the device apply stream —
+        fixed-shape event frames, no host scan per commit."""
+        if self.rn.plane is None:
+            raise RuntimeError("apply_plane is off")
+        from .applyplane import fnv1a32
+
+        with self._lock:
+            slot = int(self._watch_next[group])
+            if slot >= self.cfg.apply_watch_slots:
+                raise RuntimeError(
+                    f"group {group}: watch slots exhausted")
+            self._watch_next[group] = slot + 1
+            self._watches[(int(group), slot)] = bytes(key)
+        self.rn.watch_set(group, slot, fnv1a32(key))
+        self._work.set()
+        return slot
+
+    def watch_events(self) -> List[Dict[str, object]]:
+        """Drain pending watch events: one dict per (event, armed
+        slot), the registered key bytes resolved from the slot
+        bitmap."""
+        out: List[Dict[str, object]] = []
+        if self.rn.plane is None:
+            return out
+        for row, op, kh, rev, wmask in self.rn.drain_plane_events():
+            for s in range(self.cfg.apply_watch_slots):
+                if wmask & (1 << s):
+                    out.append({
+                        "group": int(row), "slot": s,
+                        "op": "PUT" if op == 1 else "DELETE",
+                        "key": self._watches.get(
+                            (int(row), s), b"").hex(),
+                        "key_hash": int(kh), "rev": int(rev),
+                    })
+        return out
+
     def _lifecycle_pass(self) -> None:
         """One bounded lifecycle step, riding the inline drain or the
         WAL-commit worker AFTER a covering fsync (never with _lock or
@@ -1590,6 +1792,7 @@ class MultiRaftMember:
             m_last = self.rn.m_last
             ring = self.rn.m_ring
             w = self.cfg.window
+            cand: List[Tuple[int, int, int, object]] = []
             for g in due.tolist():
                 idx = int(self.applied_index[g])
                 last = int(m_last[g])
@@ -1602,8 +1805,15 @@ class MultiRaftMember:
                 term = int(ring[g, idx % w])
                 if term <= 0:
                     continue
-                builds.append((g, idx, term, self.kvs[g].snapshot(),
-                               self.conf.conf_state(g)))
+                cand.append((g, idx, term, self.conf.conf_state(g)))
+            if cand:
+                # App-state capture for the whole build batch at once:
+                # with the plane on this is ONE padded device gather
+                # instead of a host dict walk per group under _lock.
+                blobs = self._snap_data_many([g for g, *_ in cand])
+                builds = [(g, idx, term, blob, cs)
+                          for (g, idx, term, cs), blob
+                          in zip(cand, blobs)]
         if not builds:
             return
         built: List[Tuple[int, int, int]] = []
@@ -2118,7 +2328,9 @@ class MultiRaftMember:
                         metadata=SnapshotMetadata(
                             index=idx, term=t,
                             conf_state=self.conf.conf_state(row)),
-                        data=self.kvs[row].snapshot(),
+                        # One-row capture on the rare catch-up path
+                        # (two-tier blob when the plane is on).
+                        data=self._snap_data_many([row])[0],
                     )
                 out.append((row, m))
         if io_fail is not None:
@@ -2146,6 +2358,15 @@ class MultiRaftMember:
         self.stats["apply_s"] += t1 - t0
         if self._h_phase is not None:
             self._h_phase["apply"].observe(t1 - t0)
+        if self._m_ap_slots is not None and rd.committed:
+            ps = self.rn.plane_stats
+            self._m_ap_slots.set(ps["slots_hw"])
+            self._m_ap_leases.set(ps["active_leases"])
+            self._m_ap_overflow.set(ps["overflow_rows"])
+            we = int(ps["watch_events"])
+            if we > self._ap_we_prev:
+                self._m_ap_watch.inc(we - self._ap_we_prev)
+                self._ap_we_prev = we
         # 3b. send OUTSIDE the lock: delivery takes the receiver's lock,
         #     and two members sending to each other must not deadlock.
         # "send" = the instant this round's outbound batch is handed to
@@ -2543,10 +2764,41 @@ class MultiRaftMember:
             "full_refusals": int(
                 self.stats.get("ring_full_refusals", 0)),
         }
+        # Device apply plane visibility (ISSUE 19): slot occupancy
+        # high-water vs capacity, live lease/watch census, and the
+        # lease-read hit ratio — fleet_console's plane columns read
+        # this.
+        ap: Dict[str, object] = {"enabled": False}
+        if self.rn.plane is not None:
+            ps = dict(self.rn.plane_stats)
+            hits = int(self.stats.get("lease_read_hits", 0))
+            falls = int(self.stats.get("lease_read_fallbacks", 0))
+            ap = {
+                "enabled": True,
+                "capacity": int(self.cfg.apply_capacity),
+                "watch_slots": int(self.cfg.apply_watch_slots),
+                "slots_high_water": int(ps["slots_hw"]),
+                "overflow_rows": int(ps["overflow_rows"]),
+                "active_leases": int(ps["active_leases"]),
+                "dispatches": int(ps["dispatches"]),
+                "puts": int(ps["puts"]),
+                "dels": int(ps["dels"]),
+                "expired": int(ps["expired"]),
+                "watch_events": int(ps["watch_events"]),
+                "watch_armed": len(self._watches),
+                "lease_holders": int(
+                    (self.rn.m_lease_ticks > 0).sum()),
+                "lease_read_hits": hits,
+                "lease_read_fallbacks": falls,
+                "lease_hit_ratio": (
+                    round(hits / (hits + falls), 4)
+                    if hits + falls else 0.0),
+            }
         return {
             "wal_pipeline": wal_pipe,
             "lifecycle": lifecycle,
             "ring": ring,
+            "apply_plane": ap,
             "fence_enabled": self.fence_enabled,
             # IO-error contract visibility (ISSUE 15): live ENOSPC
             # back-pressure, the fail-stop cause when a storage fault
@@ -2603,7 +2855,7 @@ class MultiRaftMember:
                             + 1)
                         return
                     snap_term = m.snapshot.metadata.term
-                    self.kvs[group].restore(m.snapshot.data)
+                    self._restore_data(group, m.snapshot.data, idx)
                     self.applied_index[group] = idx
                     self.rn.install_snapshot_state(group, idx)
                     # WAL-record the snapshot before any post-restore
@@ -2778,6 +3030,16 @@ class MultiRaftMember:
         (ref: raft.go:1339 MsgTransferLeader, campaignTransfer)."""
         if not self.rn.is_leader(group):
             return False
+        if self.rn.plane is not None:
+            # Block lease reads for the group BEFORE the transfer
+            # stages: the device zeroes the lease lane in the same
+            # round the transfer applies, but a read racing the
+            # staging window would still see the stale mirror —
+            # MsgTimeoutNow bypasses the election-timeout silence the
+            # lease safety argument rests on. The block lifts once
+            # the mirror reads 0 (linearizable_get).
+            with self._lock:
+                self._lease_block.add(int(group))
         self.rn.transfer_leader(group, target_member - 1)
         self._work.set()
         return True
@@ -2793,9 +3055,49 @@ class MultiRaftMember:
         covers the confirmed index, then read (ref: v3_server.go
         linearizableReadLoop over Ready.ReadStates — here the batch
         runs in the device kernel). Raises on a non-leader member so
-        callers redirect like clients following leader hints."""
+        callers redirect like clients following leader hints.
+
+        Lease fast path (cfg.apply_plane): when this member's lease
+        lane shows quorum evidence within the last election-timeout
+        ticks (minus lease_read_margin for tick skew), no other leader
+        can exist — a peer needs a full election timeout of leader
+        silence to win, counted in the same tick currency — so the
+        local applied state IS linearizable and the read is one host
+        lookup with ZERO per-read quorum rounds (ref: raft §6.4 /
+        etcd ReadOnlyLeaseBased). Every acknowledged write on this
+        group was acknowledged at-or-below the local apply watermark
+        (writes ack on this member after apply), and prior ReadIndex
+        reads waited for apply too, so serving the applied host tier
+        preserves real-time order. Transfers break the silence
+        argument (MsgTimeoutNow campaigns immediately): _lease_block
+        refuses lease reads from transfer staging until the device
+        round zeroes the lane."""
         if not self.rn.is_leader(group):
             raise NotLeaderError(f"group {group}: not leader here")
+        if self.rn.plane is not None:
+            with self._lock:
+                lt = int(self.rn.m_lease_ticks[group])
+                if group in self._lease_block:
+                    if lt == 0:
+                        # Device processed the transfer staging; from
+                        # here the mirror is truth again (it stays 0
+                        # until quorum evidence re-arms it with no
+                        # transfer in flight).
+                        self._lease_block.discard(group)
+                    lt = 0
+                hit = lt >= self.cfg.lease_read_margin
+                if hit:
+                    self.stats["lease_read_hits"] = (
+                        self.stats.get("lease_read_hits", 0) + 1)
+                    if self._m_ap_hit is not None:
+                        self._m_ap_hit.inc()
+                else:
+                    self.stats["lease_read_fallbacks"] = (
+                        self.stats.get("lease_read_fallbacks", 0) + 1)
+                    if self._m_ap_fb is not None:
+                        self._m_ap_fb.inc()
+            if hit:
+                return self._lease_masked_get(group, key)
         # Any batch already opened captured its commit index BEFORE
         # this request; the serving batch must open at-or-after it
         # (the device latches requests arriving mid-batch, so waiting
@@ -3591,10 +3893,17 @@ class MultiRaftCluster:
             self.members.values, g, timeout=timeout)
 
     def put(self, group: int, key: bytes, value: bytes,
-            timeout: float = 10.0) -> None:
+            timeout: float = 10.0, lease_ttl: int = 0) -> None:
         """Client write: find the leader, propose, wait for local apply
-        (read-your-write via the leader's applied state)."""
-        payload = GroupKV.put_payload(key, value)
+        (read-your-write via the leader's applied state). lease_ttl>0
+        attaches a plane lease (ticks): the replicated bytes are
+        identical everywhere, expiry visibility is leader-local."""
+        if lease_ttl:
+            from .applyplane import put_payload
+
+            payload = put_payload(key, value, lease_ttl)
+        else:
+            payload = GroupKV.put_payload(key, value)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             for m in self.members.values():

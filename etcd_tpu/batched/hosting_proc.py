@@ -275,20 +275,30 @@ class AdminServer:
         if op == "bench":
             return self._bench(int(req["n"]),
                                int(req.get("value_size", 64)),
-                               int(req.get("inflight", 4)))
+                               int(req.get("inflight", 4)),
+                               float(req.get("read_mix", 0.0)))
         if op == "stop":
             threading.Thread(target=self._shutdown, daemon=True).start()
             return {"ok": True}
         return {"err": f"unknown op {op}"}
 
     def _bench(self, n: int, value_size: int,
-               inflight: int = 4) -> Dict:
+               inflight: int = 4, read_mix: float = 0.0) -> Dict:
         """Hosted-path benchmark: propose n entries across the groups
         this member leads, confirm each applied locally (read-your-
         write at the leader), report throughput + commit p50/p99 —
-        the service-rate number next to bench.py's kernel rate."""
+        the service-rate number next to bench.py's kernel rate.
+
+        read_mix in (0, 1] converts that fraction of the n ops into
+        linearizable reads interleaved with the put stream (the first
+        non-put hosted workload): each read is a synchronous
+        linearizable_get on a bench key of a led group — lease-held
+        leaders serve it locally with zero quorum rounds, cold leaders
+        fall back to ReadIndex; the hit/fallback split rides the
+        result so hosted_bench's SLO table reports the read hop."""
         import numpy as np
 
+        from ..pkg.errors import NotLeaderError
         from .hosting import GroupKV
         from .state import LEADER
 
@@ -297,6 +307,27 @@ class AdminServer:
         if not own:
             return {"err": "no groups led by this member"}
         val = b"v" * value_size
+        n_reads = max(0, min(n, int(round(n * read_mix))))
+        n = n - n_reads
+        rd_lat: List[float] = []
+        rd_lost = 0
+        rd_issued = 0
+        hits0 = int(m.stats.get("lease_read_hits", 0))
+        falls0 = int(m.stats.get("lease_read_fallbacks", 0))
+
+        def do_reads(owed: int) -> None:
+            nonlocal rd_issued, rd_lost
+            for _ in range(owed):
+                g = own[rd_issued % len(own)]
+                k = b"bench-%d" % (rd_issued % max(n, 1))
+                t0 = time.perf_counter()
+                try:
+                    m.linearizable_get(g, k, timeout=5.0)
+                    rd_lat.append(time.perf_counter() - t0)
+                except (NotLeaderError, TimeoutError):
+                    rd_lost += 1
+                rd_issued += 1
+
         t_start = time.perf_counter()
         # Pipeline: propose in waves to bound the per-group inflight
         # (the engine caps proposals staged per round). A proposal
@@ -360,23 +391,30 @@ class AdminServer:
                         q.popleft()
                         outstanding -= 1
                         lost += 1
+            # Interleave owed reads with the put stream (same clock,
+            # same thread — the mix is a schedule, not a second
+            # phase, so the A/B stays same-day AND same-second).
+            if n_reads and n:
+                do_reads(min(i * n_reads // n, n_reads) - rd_issued)
             if now > deadline:
                 lost += outstanding
                 outstanding = 0
                 break
             if outstanding:
                 time.sleep(0.005)
+        if n_reads:
+            do_reads(n_reads - rd_issued)  # pure-read mixes land here
         dt = time.perf_counter() - t_start
-        if not lat:
-            return {"err": "no puts completed", "lost": lost}
-        lat_ms = sorted(x * 1000 for x in lat)
-        return {
+        if not lat and not rd_lat:
+            return {"err": "no ops completed", "lost": lost + rd_lost}
+        lat_ms = sorted(x * 1000 for x in lat) or [0.0]
+        out = {
             "ok": True,
             "n": n,
             "completed": len(lat),
             "lost": lost,
             "groups": len(own),
-            "puts_per_sec": round(len(lat) / dt, 1),
+            "puts_per_sec": round(len(lat) / dt, 1) if lat else 0.0,
             "p50_ms": round(lat_ms[len(lat_ms) // 2], 3),
             "p99_ms": round(lat_ms[int(len(lat_ms) * 0.99) - 1], 3),
             # Raw samples so a multi-member harness can compute true
@@ -384,6 +422,25 @@ class AdminServer:
             # not a percentile of anything).
             "lat_ms_samples": [round(x, 2) for x in lat_ms],
         }
+        if n_reads:
+            rms = sorted(x * 1000 for x in rd_lat) or [0.0]
+            out.update({
+                "reads": n_reads,
+                "reads_completed": len(rd_lat),
+                "reads_lost": rd_lost,
+                "reads_per_sec": (
+                    round(len(rd_lat) / dt, 1) if rd_lat else 0.0),
+                "read_p50_ms": round(rms[len(rms) // 2], 3),
+                "read_p99_ms": round(rms[int(len(rms) * 0.99) - 1], 3),
+                "read_lat_ms_samples": [round(x, 2) for x in rms],
+                # Serving-path split over THIS bench window (stats
+                # deltas): lease_hit reads took zero quorum rounds.
+                "lease_hits": int(
+                    m.stats.get("lease_read_hits", 0)) - hits0,
+                "lease_fallbacks": int(
+                    m.stats.get("lease_read_fallbacks", 0)) - falls0,
+            })
+        return out
 
     def close(self) -> None:
         """Close the listening socket WITHOUT exiting the process —
@@ -426,7 +483,8 @@ def serve(member_id: int, num_members: int, num_groups: int,
           pin_core: Optional[int] = None,
           snap_cadence: Optional[int] = None,
           snap_keep: int = 2,
-          wal_rotate_bytes: Optional[int] = None) -> None:
+          wal_rotate_bytes: Optional[int] = None,
+          apply_plane: bool = False) -> None:
     from .hosting import MultiRaftMember
     from .state import BatchedConfig
 
@@ -465,6 +523,11 @@ def serve(member_id: int, num_members: int, num_groups: int,
         # --fleet: device-side fleet SummaryFrame + FleetHub, served
         # through the admin 'fleet' op (tools/fleet_console.py).
         fleet_summary=fleet,
+        # --apply-plane (ISSUE 19): device-resident KV/watch/lease
+        # tensors + leader-lease local reads; the bench op's read_mix
+        # serving-path split and the admin 'health' apply_plane block
+        # light up with it.
+        apply_plane=apply_plane,
     )
     member = MultiRaftMember(
         member_id, num_members, num_groups, data_dir, cfg=cfg,
@@ -561,6 +624,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                         "bytes and release sealed segments once every "
                         "group's snapshot covers them (off by "
                         "default)")
+    p.add_argument("--apply-plane", action="store_true",
+                   help="enable the device-resident apply plane "
+                        "(tensorized KV/watch/lease state + leader-"
+                        "lease local reads; protocol state stays "
+                        "bit-identical — see README 'Device apply "
+                        "plane')")
     a = p.parse_args(argv)
 
     def hp(s: str) -> Tuple[str, int]:
@@ -578,7 +647,8 @@ def main(argv: Optional[List[str]] = None) -> None:
           wal_pipeline=a.wal_pipeline or None,
           fabric=a.fabric, shm_dir=a.shm_dir, pin_core=a.pin_core,
           snap_cadence=a.snap_cadence, snap_keep=a.snap_keep,
-          wal_rotate_bytes=a.wal_rotate_bytes)
+          wal_rotate_bytes=a.wal_rotate_bytes,
+          apply_plane=a.apply_plane)
 
 
 # -- client side ---------------------------------------------------------------

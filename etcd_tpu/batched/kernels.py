@@ -201,6 +201,13 @@ def invariant_bits(st, slot) -> jnp.ndarray:
         # broke (wrap = silent log corruption, the worst failure the
         # ring representation admits).
         (st.last - st.snap_index) > st.log_term.shape[-1],
+        # leader-lease residue on a non-leader: the lease lane
+        # authorizes quorum-free linearizable reads, so every
+        # step-down path must zero it in the same round (step.py's
+        # post-emit re-arm does exactly that) — a trip here is a
+        # stale read authorization, the one failure mode the lease
+        # fast path admits.
+        (st.lease_ticks > 0) & ~is_leader,
     ]
     bits = jnp.zeros((), I32)
     for i, b in enumerate(bad):

@@ -291,6 +291,10 @@ class BatchedRawNode:
         self.m_view: Tuple[np.ndarray, np.ndarray, np.ndarray] = (
             self.m_term, self.m_role, self.m_lead)
         self.m_ring = np.zeros((self.n, cfg.window), np.int64)
+        # Leader-lease lane mirror (state.lease_ticks): the hosting
+        # layer's lease-first read routing compares this against
+        # cfg.lease_read_margin — one numpy read, zero device hops.
+        self.m_lease_ticks = np.zeros(self.n, np.int64)
         self.applied = np.full(self.n, start_index, np.int64)
         self.stable = np.full(self.n, start_index, np.int64)
 
@@ -375,6 +379,60 @@ class BatchedRawNode:
         # program and protocol state are identical with it on or off;
         # the hot path pays one `is not None` per round when off.
         self.tracer = None
+
+        # Device-resident apply plane (cfg.apply_plane, applyplane.py):
+        # a SEPARATE jitted program folding each round's committed
+        # entries into per-row KV/revision/watch/lease tensors —
+        # dispatched right after committed-range extraction, where the
+        # payload bytes are in hand. The round-step program is shared
+        # with apply_plane=False by construction (make_step_round
+        # strips the plane knobs from the compile key).
+        self.plane = None
+        if cfg.apply_plane:
+            from .applyplane import init_plane, make_dispatch
+
+            self.plane = init_plane(cfg, self.n)
+            self._plane_step = make_dispatch(cfg, self.n)
+            self._wkey_plane = f"apply_plane/{hash((cfg, self.n))}"
+            # Watch events drained by the hosting layer (row, op,
+            # key_hash, rev, wmask); bounded — watches are telemetry
+            # consumers, and a stalled drain must not grow the heap.
+            self.plane_events: deque = deque(maxlen=8192)
+            # Host-accumulated plane stats (round thread writes, any
+            # thread reads — GIL-atomic scalar swaps).
+            self.plane_stats: Dict[str, int] = {
+                "dispatches": 0, "puts": 0, "dels": 0, "expired": 0,
+                "watch_events": 0, "slots_hw": 0, "overflow_rows": 0,
+                "active_leases": 0,
+            }
+            # Staged plane edits from foreign threads, applied at the
+            # head of advance_round ON the round thread (the staged-
+            # edit idiom of _pending_conf): watch-slot arms and
+            # snapshot-restored row images.
+            self._pending_watch: Dict[Tuple[int, int], int] = {}
+            self._pending_plane_rows: Dict[int, Tuple] = {}
+            # Serializes the donated plane carry between the round
+            # thread's dispatch and plane_capture's snapshot gather —
+            # a gather racing a dispatch would read a donated (freed)
+            # buffer.
+            self._plane_mu = threading.Lock()
+            # Host mirrors of the plane clock and the highest entry
+            # index folded per row (round thread writes; any thread
+            # reads — np scalar loads are GIL-atomic). The applied
+            # watermark makes re-dispatch idempotent: a plane image
+            # restored AHEAD of the host snapshot index (cadence
+            # capture runs off the round thread's commit stream, which
+            # leads the apply drain) must not double-fold the WAL tail
+            # the host re-delivers on boot.
+            self.m_plane_tick = np.zeros(self.n, np.int64)
+            self.m_plane_applied = np.zeros(self.n, np.int64)
+            # Exact host lessor mirror: (row, key bytes) -> absolute
+            # plane-tick expiry, replayed from the same payload stream
+            # at the same tick arithmetic as the device kernel. The
+            # lease-read path masks host-tier bytes through it (the
+            # device stores hashes only — byte honesty). Round thread
+            # writes; readers do GIL-atomic gets.
+            self.plane_lessor: Dict[Tuple[int, bytes], int] = {}
 
     # -- boot ------------------------------------------------------------------
 
@@ -538,6 +596,83 @@ class BatchedRawNode:
         with self._lock:
             self._pending_fence[row] = bool(on)
 
+    def watch_set(self, row: int, wslot: int, key_hash: int) -> None:
+        """Stage an exact-key watch into plane watch slot ``wslot`` of
+        ``row`` (0 disarms). STAGED like set_fence: the device edit
+        lands at the head of the next round on the round thread."""
+        assert self.plane is not None, "apply plane is off"
+        assert 0 <= wslot < self.cfg.apply_watch_slots
+        with self._lock:
+            self._pending_watch[(int(row), int(wslot))] = int(key_hash)
+
+    def plane_restore_row(self, row: int, kv_key, kv_rev, kv_val,
+                          kv_lease, rev: int, tick: int,
+                          overflow: bool, applied: int = 0,
+                          lessor=()) -> None:
+        """Stage a full plane-row image (snapshot install / boot
+        rebuild): fixed-width [C] i32 vectors + scalars, applied on the
+        round thread before the next dispatch. ``applied`` is the
+        highest entry index the image covers (dispatch skips at-or-
+        below it); ``lessor`` is the row's (key bytes, expiry tick)
+        mirror entries."""
+        assert self.plane is not None, "apply plane is off"
+        c = self.cfg.apply_capacity
+        img = tuple(np.asarray(x, np.int32).reshape(c)
+                    for x in (kv_key, kv_rev, kv_val, kv_lease))
+        with self._lock:
+            self._pending_plane_rows[int(row)] = img + (
+                int(rev), int(tick), bool(overflow), int(applied),
+                [(bytes(k), int(e)) for k, e in lessor])
+
+    def drain_plane_events(self) -> List[Tuple[int, int, int, int, int]]:
+        """Pop every pending (row, op, key_hash, rev, wmask) watch
+        event (round thread appends; any thread drains — deque ops are
+        GIL-atomic)."""
+        if self.plane is None:
+            return []
+        evs = []
+        try:
+            while True:
+                evs.append(self.plane_events.popleft())
+        except IndexError:
+            pass
+        return evs
+
+    def plane_capture(self, rows) -> List[Dict[str, object]]:
+        """Snapshot-capture gather: ONE padded device gather for the
+        whole build batch (hosting's _build_snapshots seam — the host
+        dict walk does not survive large G). Returns one JSON-ready
+        dict per requested row. Safe from any thread: _plane_mu
+        excludes the dispatch that donates the plane carry."""
+        assert self.plane is not None, "apply plane is off"
+        from .applyplane import gather_rows
+
+        rows = np.asarray(rows, np.int32).reshape(-1)
+        m = len(rows)
+        pad = np.zeros(max(m, 1), np.int32)
+        pad[:m] = rows
+        with self._plane_mu:
+            g = gather_rows(self.plane, pad)
+            jax.block_until_ready(g[0])
+            parts = [np.asarray(x) for x in g]
+            applied = self.m_plane_applied[rows].tolist()
+            tick = self.m_plane_tick[rows].tolist()
+            less = {int(r): [] for r in rows}
+            for (r2, kb), exp in list(self.plane_lessor.items()):
+                if r2 in less:
+                    less[r2].append((kb, exp))
+        kk, kr, kv, kl, rv, tk, ov = parts
+        out = []
+        for j, r in enumerate(rows.tolist()):
+            out.append({
+                "kv_key": kk[j].tolist(), "kv_rev": kr[j].tolist(),
+                "kv_val": kv[j].tolist(), "kv_lease": kl[j].tolist(),
+                "rev": int(rv[j]), "tick": int(tick[j]),
+                "overflow": bool(ov[j]), "applied": int(applied[j]),
+                "lessor": [[kb.hex(), int(e)] for kb, e in less[r]],
+            })
+        return out
+
     def pending_proposals(self, row: int) -> int:
         with self._lock:
             return len(self._props[row])
@@ -615,6 +750,9 @@ class BatchedRawNode:
                 self._pending or self._blocks or self._poked
                 or self._pending_conf or self._pending_compact
                 or self._pending_fence
+                or (self.plane is not None
+                    and (self._pending_watch
+                         or self._pending_plane_rows))
                 or self._ticks.any()
                 or self._campaign.any()
                 or self._transfer.any()
@@ -665,6 +803,12 @@ class BatchedRawNode:
             self._pending_compact = {}
             pend_fence = self._pending_fence
             self._pending_fence = {}
+            pend_watch = pend_plane = None
+            if self.plane is not None:
+                pend_watch = self._pending_watch
+                self._pending_watch = {}
+                pend_plane = self._pending_plane_rows
+                self._pending_plane_rows = {}
             props_n = np.fromiter(
                 (min(len(q), cfg.max_props_per_round) for q in self._props),
                 np.int32, count=self.n,
@@ -726,6 +870,49 @@ class BatchedRawNode:
                 send_append=st0.send_append.at[jnp.asarray(poke_rows)]
                 .set(True)
             )
+        # Staged plane edits (watch arms, snapshot-restored row
+        # images) — the round thread is the only writer of self.plane,
+        # same contract as self.state above.
+        if pend_watch:
+            keys = list(pend_watch)
+            wr = jnp.asarray([k[0] for k in keys], jnp.int32)
+            wc = jnp.asarray([k[1] for k in keys], jnp.int32)
+            wv = jnp.asarray([pend_watch[k] for k in keys], jnp.int32)
+            self.plane = self.plane._replace(
+                watch_key=self.plane.watch_key.at[wr, wc].set(wv))
+        if pend_plane:
+            pl = self.plane
+            rows2 = np.fromiter(pend_plane, np.int32, len(pend_plane))
+            imgs = [pend_plane[int(r2)] for r2 in rows2]
+            ridx = jnp.asarray(rows2)
+            as_j = lambda i: jnp.asarray(  # noqa: E731
+                np.stack([im[i] for im in imgs]))
+            sc = lambda i, dt=np.int32: jnp.asarray(  # noqa: E731
+                np.fromiter((im[i] for im in imgs), dt, len(imgs)))
+            with self._plane_mu:
+                self.plane = pl._replace(
+                    kv_key=pl.kv_key.at[ridx].set(as_j(0)),
+                    kv_rev=pl.kv_rev.at[ridx].set(as_j(1)),
+                    kv_val=pl.kv_val.at[ridx].set(as_j(2)),
+                    kv_lease=pl.kv_lease.at[ridx].set(as_j(3)),
+                    rev=pl.rev.at[ridx].set(sc(4)),
+                    tick=pl.tick.at[ridx].set(sc(5)),
+                    overflow=pl.overflow.at[ridx].set(sc(6, bool)),
+                )
+                for r2 in rows2.tolist():
+                    im = pend_plane[int(r2)]
+                    self.m_plane_tick[r2] = im[5]
+                    self.m_plane_applied[r2] = im[7]
+                    # Lessor swap: drop every entry for the row, then
+                    # install the image's (built as a list first — no
+                    # structural iteration over a dict readers get()
+                    # from).
+                    stale = [k for k in self.plane_lessor
+                             if k[0] == int(r2)]
+                    for k in stale:
+                        del self.plane_lessor[k]
+                    for kb, exp in im[8]:
+                        self.plane_lessor[(int(r2), kb)] = exp
         tr_dispatch = time.monotonic_ns() if tracer is not None else 0
         # Host->device staging happens OUTSIDE the transfer guard (it
         # is the intended, bulk transfer of the round); the guarded
@@ -757,13 +944,13 @@ class BatchedRawNode:
         jax.block_until_ready(st.term)
         (term, vote, commit, last, role, lead, snap_i, snap_t, ring,
          rd_seq, rd_idx, rd_ready,
-         mid_seq, mid_idx, mid_ready, last_tick) = [
+         mid_seq, mid_idx, mid_ready, last_tick, lease_tk) = [
             np.asarray(x) for x in (
                 st.term, st.vote, st.commit, st.last, st.role, st.lead,
                 st.snap_index, st.snap_term, st.log_term,
                 st.read_seq, st.read_index, st.read_ready,
                 aux.read_seq, aux.read_index, aux.read_ready,
-                aux.last_tick,
+                aux.last_tick, st.lease_ticks,
             )
         ]
         words = np.asarray(words_d)
@@ -1021,8 +1208,17 @@ class BatchedRawNode:
                 (int(row), int(rd_seq[row]), int(rd_idx[row])))
             self._read_seen[row] = int(rd_seq[row])
 
+        # Apply-plane dispatch: fold this round's committed entries
+        # (payload bytes in hand from the extraction above) and staged
+        # ticks into the device KV/watch/lease tensors. After the lock:
+        # it reads only local extraction results and self.plane, whose
+        # single writer is this thread.
+        if self.plane is not None and (committed or ticks.any()):
+            self._plane_dispatch(committed, ticks)
+
         self._round = (term, vote, commit, last, role, lead,
-                       snap_i.astype(np.int64), ring64)
+                       snap_i.astype(np.int64), ring64,
+                       lease_tk.astype(np.int64))
         snap_rings = {
             row: ring64[row].copy()
             for row, m in messages if int(m.type) == T_SNAP
@@ -1046,7 +1242,8 @@ class BatchedRawNode:
         """Confirm the last Ready: host mirrors move to the new state
         (ref: rawnode.go:174-179 Advance)."""
         assert self._round is not None
-        (term, vote, commit, last, role, lead, snap_i, ring64) = self._round
+        (term, vote, commit, last, role, lead, snap_i, ring64,
+         lease_tk) = self._round
         with self._lock:
             # Under _lock: transport threads mutate self.applied via
             # install_snapshot_state, and read the mirrors.
@@ -1054,6 +1251,7 @@ class BatchedRawNode:
             self.m_last, self.m_role, self.m_lead = last, role, lead
             self.m_view = (term, role, lead)
             self.m_snap, self.m_ring = snap_i, ring64
+            self.m_lease_ticks = lease_tk
             self.applied = np.maximum(self.applied, commit)
             self.stable = last.copy()
             # GC arena below the compaction floor.
@@ -1067,6 +1265,104 @@ class BatchedRawNode:
             self._round = None
 
     # -- internals -------------------------------------------------------------
+
+    def _plane_dispatch(self, committed, ticks: np.ndarray) -> None:
+        """Fold one round's committed KV payloads + staged ticks into
+        the device apply plane (round thread only). Rows committing
+        more than A = cfg.apply_records entries redispatch the same
+        compiled program with the next record chunk — shape-static by
+        construction; the tick advance rides chunk 0 only."""
+        from .applyplane import OP_PUT, fnv1a32, parse_payload
+
+        cfg = self.cfg
+        a, n = cfg.apply_records, self.n
+        new_tick = self.m_plane_tick + ticks.astype(np.int64)
+        recs: Dict[int, List[Tuple[int, int, int, int]]] = {}
+        lessor = self.plane_lessor
+        for row, items in committed:
+            lst = []
+            floor = int(self.m_plane_applied[row])
+            top = floor
+            for i, _t, d, et in items:
+                if i <= floor:
+                    # Already folded (a restored plane image can lead
+                    # the host apply watermark; the boot replay and
+                    # post-install tail re-deliver that span).
+                    continue
+                top = max(top, int(i))
+                if et != 0 or not d:
+                    # Conf entries and unknown payloads (arena holes)
+                    # skip the KV tier — exactly the host loop's rule.
+                    continue
+                p = parse_payload(d)
+                if p is None:
+                    continue
+                op, k, v, ttl = p
+                lst.append((op, fnv1a32(k),
+                            fnv1a32(v) if op == OP_PUT else 0,
+                            ttl if op == OP_PUT else 0))
+                # Lessor mirror: same record, same tick arithmetic as
+                # the device kernel (chunk 0 advances the clock, so
+                # every chunk applies at new_tick).
+                if op == OP_PUT and ttl > 0:
+                    lessor[(row, k)] = int(new_tick[row]) + ttl
+                else:
+                    lessor.pop((row, k), None)
+            if top > floor:
+                self.m_plane_applied[row] = top
+            if lst:
+                recs[row] = lst
+        longest = max((len(v) for v in recs.values()), default=0)
+        nchunks = max(1, -(-longest // a))
+        stats = self.plane_stats
+        self.m_plane_tick = new_tick
+        frames = []
+        with self._plane_mu:
+            for ci in range(nchunks):
+                ops = np.zeros((n, a), np.int32)
+                keys = np.zeros((n, a), np.int32)
+                vals = np.zeros((n, a), np.int32)
+                ttls = np.zeros((n, a), np.int32)
+                for row, lst in recs.items():
+                    for j, (op, k, v, ttl) in enumerate(
+                            lst[ci * a:(ci + 1) * a]):
+                        ops[row, j] = op
+                        keys[row, j] = k
+                        vals[row, j] = v
+                        ttls[row, j] = ttl
+                ta = (ticks.astype(np.int32) if ci == 0
+                      else np.zeros(n, np.int32))
+                # Host→device staging outside the guard (the intended
+                # bulk transfer); the guarded dispatch is pure warm
+                # device work. Frame drain waits until AFTER the chunk
+                # loop — one bulk sync per round, not one per chunk.
+                din = tuple(jnp.asarray(x)
+                            for x in (ops, keys, vals, ttls, ta))
+                with warm_guard(self._wkey_plane):
+                    self.plane, frame = self._plane_step(self.plane,
+                                                         *din)
+                frames.append(frame)
+            jax.block_until_ready(self.plane.rev)
+        got = jax.device_get(frames)
+        for frame in got:
+            stats["dispatches"] += 1
+            stats["puts"] += int(frame.puts.sum())
+            stats["dels"] += int(frame.dels.sum())
+            stats["expired"] += int(frame.expired.sum())
+            stats["slots_hw"] = max(
+                stats["slots_hw"], int(frame.slots_used.max()))
+            stats["overflow_rows"] = int(frame.overflow.sum())
+            stats["active_leases"] = int(frame.leases.sum())
+            hit = (frame.ev_op != 0) & (frame.ev_wmask != 0)
+            rws, lanes = np.nonzero(hit)
+            if len(rws):
+                for r2, l2 in zip(rws.tolist(), lanes.tolist()):
+                    self.plane_events.append((
+                        int(r2), int(frame.ev_op[r2, l2]),
+                        int(frame.ev_key[r2, l2]),
+                        int(frame.ev_rev[r2, l2]),
+                        int(frame.ev_wmask[r2, l2])))
+                stats["watch_events"] += len(rws)
 
     # Residual block records are bounded: raft tolerates message loss,
     # so once the residual queue exceeds this many records per inbox

@@ -136,6 +136,35 @@ class BatchedConfig(NamedTuple):
     # the identical program, fleet_summary=True keeps protocol state
     # bit-identical (the frame is a pure read of round inputs/outputs).
     fleet_summary: bool = False
+    # Device-resident apply plane (see batched/applyplane.py): the L2
+    # storage layer — a fixed-capacity per-group KV/revision hash-slot
+    # store, watch predicates as masked compares, client-lease TTL
+    # expiry, and leader leases for quorum-free linearizable reads —
+    # maintained as device tensors by a SEPARATE jitted apply program
+    # dispatched over each round's committed entries. Static plane
+    # contract, enforced structurally: none of the apply_* fields
+    # enters the round-step compile key (make_step_round normalizes
+    # them to defaults before keying), so apply_plane=False compiles
+    # the identical round program and apply_plane=True keeps protocol
+    # state bit-identical by construction.
+    apply_plane: bool = False
+    # KV slots per group row (C). A row whose live keys exceed C sets
+    # its overflow flag: the host GroupKV tier (always byte-truth)
+    # covers reads and snapshot capture for that row; device counters
+    # record the spill (capacity/overflow contract, README).
+    apply_capacity: int = 256
+    # Watch predicate slots per group row (exact-key-hash compares over
+    # the apply stream); <= 32 so the per-record match set packs into
+    # one i32 bitmap lane of the event frame.
+    apply_watch_slots: int = 8
+    # Apply records per plane dispatch (A). A round that commits more
+    # than A entries for one row dispatches the SAME compiled program
+    # again — a batching granule, not a cap.
+    apply_records: int = 8
+    # Minimum remaining leader-lease ticks for the hosting layer to
+    # serve a linearizable read locally (host-side routing threshold;
+    # the lease lane itself is part of the round program regardless).
+    lease_read_margin: int = 2
 
     @property
     def num_instances(self) -> int:
@@ -163,7 +192,40 @@ class BatchedConfig(NamedTuple):
             raise ValueError(
                 f"deliver_shape={self.deliver_shape!r} not in "
                 f"{('auto',) + DELIVER_SHAPES}")
+        if self.apply_plane:
+            if self.apply_capacity < 1:
+                raise ValueError(
+                    f"apply_capacity={self.apply_capacity} must be >= 1")
+            if not 0 < self.apply_watch_slots <= 32:
+                raise ValueError(
+                    f"apply_watch_slots={self.apply_watch_slots} out of "
+                    "range 1..32: watch matches pack into one i32 "
+                    "bitmap lane of the event frame")
+            if self.apply_records < 1:
+                raise ValueError(
+                    f"apply_records={self.apply_records} must be >= 1")
+            if self.lease_read_margin < 1:
+                raise ValueError(
+                    f"lease_read_margin={self.lease_read_margin} must "
+                    "be >= 1: a zero margin serves a read on the tick "
+                    "the lease dies")
         return self
+
+    def apply_plane_key(self) -> "BatchedConfig":
+        """The round-step compile-key normalization: the apply plane is
+        a SEPARATE jitted program (applyplane.py), so none of its knobs
+        may fork the round-step program. make_step_round strips them to
+        defaults before keying step._step_round_jit — apply_plane
+        on/off therefore share ONE compiled round by construction (the
+        static-plane contract, and the reason the conftest compile-
+        shape budget does not move)."""
+        return self._replace(
+            apply_plane=False,
+            apply_capacity=256,
+            apply_watch_slots=8,
+            apply_records=8,
+            lease_read_margin=2,
+        )
 
     def resolved(self) -> "BatchedConfig":
         """Resolve deliver_shape="auto" to the platform default. Every
@@ -261,6 +323,25 @@ class BatchedState(NamedTuple):
     # (ref: raft.go campaignTransfer → ignore leader lease).
     vote_req_transfer: jnp.ndarray  # [N] bool
     send_timeout_now: jnp.ndarray  # [N] bool (target = transferee)
+
+    # Leader lease (ROADMAP item 5; the fence lane's clock-bound
+    # tick-lane compare turned outward): remaining ticks for which this
+    # leader may serve linearizable reads locally. Armed to
+    # election_timeout whenever check_quorum proves a live quorum
+    # (cq_fire & alive — the same evidence the reference's lease-based
+    # read path leans on) or commit/ReadIndex progress confirms the
+    # term; decremented each tick; zeroed on transfer/step-down. Safety
+    # argument: a peer cannot be elected before ITS election_elapsed
+    # reaches randomized_timeout >= election_timeout ticks of leader
+    # silence, so a lease armed at election_timeout and counted in the
+    # SAME tick currency expires no later than the first tick a rival
+    # could win — ticks are per-member host time, not a synchronized
+    # clock, which is exactly the reference caveat (clock drift bounds
+    # apply; reads fall back to ReadIndex when the lane is cold).
+    # Computed UNCONDITIONALLY (no apply_plane branch — the lane rides
+    # every program, keeping on/off bit-identical); write-only w.r.t.
+    # every protocol branch.
+    lease_ticks: jnp.ndarray  # [N] i32
 
 
 # Narrow storage dtype per hot lane (cfg.narrow_lanes). Values are
@@ -369,6 +450,7 @@ def init_state(cfg: BatchedConfig, start_index: int = 0,
         vote_req_is_pre=jnp.zeros((n,), bool),
         vote_req_transfer=jnp.zeros((n,), bool),
         send_timeout_now=jnp.zeros((n,), bool),
+        lease_ticks=zeros_n(),
     )
     if cfg.narrow_lanes:
         st = narrow_state(st)

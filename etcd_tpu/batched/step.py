@@ -1399,6 +1399,12 @@ def _tick(cfg: BatchedConfig, iid, slot, st: BatchedState, do_tick,
         # (ref: raft.go:670-678 tickHeartbeat abortLeaderTransfer).
         transferee=jnp.where(cq_fire, 0, st.transferee),
         transfer_sent=jnp.where(cq_fire, False, st.transfer_sent),
+        # Leader lease decays in the same tick currency the electorate
+        # measures leader silence in (see BatchedState.lease_ticks for
+        # the safety argument); quorum evidence re-arms it below and in
+        # the post-emit freshness check.
+        lease_ticks=jnp.maximum(
+            st.lease_ticks - jnp.where(do_tick & is_leader, 1, 0), 0),
     )
     if cfg.check_quorum:
         # Leader self-check every election timeout: step down when a
@@ -1414,7 +1420,14 @@ def _tick(cfg: BatchedConfig, iid, slot, st: BatchedState, do_tick,
         st1 = st1._replace(
             recent_active=jnp.where(
                 cq_fire, peers == slot, st1.recent_active
-            )
+            ),
+            # A passed quorum self-check is exactly the evidence the
+            # lease leans on: a quorum heard from us within the last
+            # election_timeout, so no rival can assemble a quorum for
+            # at least that long again.
+            lease_ticks=jnp.where(
+                cq_fire & alive & (st1.transferee == 0),
+                cfg.election_timeout, st1.lease_ticks),
         )
 
     # Follower/candidate election firing (hup gated on promotability —
@@ -1463,6 +1476,10 @@ def _control(cfg: BatchedConfig, slot, st: BatchedState, transfer_to,
         # Last-chance catch-up append (raft.go:1367-1371 sendAppend).
         send_append=st.send_append
         | ((peers == target) & (st.match < st.last)),
+        # A transferring leader stops serving lease reads NOW: the
+        # target may campaign (TimeoutNow pierces leases) before our
+        # lease would have decayed.
+        lease_ticks=jnp.zeros_like(st.lease_ticks),
     )
     st = _sel(valid_target, st_tr, st)
 
@@ -1924,6 +1941,27 @@ def _step_round_jit(cfg: BatchedConfig, with_aux: bool,
                 out, req_resps,
             )
             out = out._replace(valid=out.valid & ~iso)
+            with jax.named_scope("raft_lease"):
+                # Quorum-evidence lease re-arm (BatchedState.lease_ticks):
+                # commit progress this round means a quorum just acked
+                # our log; a ReadIndex batch confirming means a quorum
+                # just answered our heartbeat ctx. Either way no rival
+                # can win for >= election_timeout of our ticks. Leaders
+                # mid-transfer never re-arm; non-leaders hold zero (the
+                # one step-down path, so every become_follower variant
+                # is covered without touching it).
+                # read_snap, not sti.read_ready: a batch can confirm in
+                # deliver and be replaced by a latched reopen within
+                # this same round — the confirmation still happened.
+                fresh = (
+                    (sti.role == LEADER) & (sti.transferee == 0)
+                    & ((sti.commit > pre.commit)
+                       | (read_snap[2] & ~pre.read_ready))
+                )
+                lease = jnp.where(
+                    fresh, cfg.election_timeout, sti.lease_ticks)
+                sti = sti._replace(lease_ticks=jnp.where(
+                    sti.role == LEADER, lease, 0))
             ret = (sti, out, StepAux(last_tick, *read_snap))
             if cfg.telemetry:
                 with jax.named_scope("raft_telemetry"):
@@ -2009,6 +2047,12 @@ def make_step_round(cfg: BatchedConfig, iids=None, slots=None,
     # _step_round_jit on why the occupancy reduce must not cross
     # shards.
     cfg = cfg.resolved()
+    # Apply-plane knobs never enter the round-step program (the plane
+    # is a separate jitted program, applyplane.py): strip them to
+    # defaults before the per-config jit cache so apply_plane on/off
+    # share ONE compiled round — the static-plane contract enforced
+    # structurally, and the conftest compile-shape budget stays put.
+    cfg = cfg.apply_plane_key()
     if iids is None:
         iids = jnp.arange(cfg.num_instances, dtype=I32)
     else:

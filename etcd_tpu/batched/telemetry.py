@@ -98,6 +98,9 @@ INV_NAMES = (
     # beyond the ring width W: an append crossed the compaction floor
     # (wrap = silent log corruption; the ring_full back-pressure lane
     # exists to make this unreachable)
+    "lease_on_nonleader",   # leader-lease tick residue on a
+    # non-leader: a stale quorum-free read authorization (ISSUE 19 —
+    # every step-down path must zero the lane in the same round)
 )
 
 
@@ -409,6 +412,65 @@ def shm_ring_full_counter(
         "drop-don't-block; records counted on "
         "etcd_tpu_router_loss_total cls=ring_full_drop)",
         ("member", "peer", "ring"),
+    ))
+
+
+# Device apply-plane families (ISSUE 19, batched/applyplane.py): the
+# hosting layer folds rawnode.plane_stats + its own lease-read
+# counters into these after each health/metrics pass — fleet_console's
+# plane columns and the read-mix SLO row read them back.
+
+
+def apply_plane_slots_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_apply_plane_slots_high_water",
+        "device KV slot occupancy high-water across a member's rows "
+        "(vs cfg.apply_capacity; overflow rows spill to the host tier)",
+        ("member",),
+    ))
+
+
+def apply_plane_leases_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_apply_plane_active_leases",
+        "live (unexpired) key leases on the device plane, member-wide",
+        ("member",),
+    ))
+
+
+def apply_plane_overflow_gauge(
+        registry: Optional[pmet.Registry] = None) -> pmet.Gauge:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Gauge(
+        "etcd_tpu_apply_plane_overflow_rows",
+        "rows whose device KV store overflowed capacity (sticky; "
+        "reads for spilled keys stay host-tier correct)",
+        ("member",),
+    ))
+
+
+def apply_plane_watch_events_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_apply_plane_watch_events_total",
+        "watch events emitted by device apply-stream matching",
+        ("member",),
+    ))
+
+
+def apply_plane_reads_counter(
+        registry: Optional[pmet.Registry] = None) -> pmet.Counter:
+    reg = registry or pmet.DEFAULT
+    return reg.register(pmet.Counter(
+        "etcd_tpu_apply_plane_reads_total",
+        "linearizable reads by serving path: kind=lease_hit (zero "
+        "quorum rounds) vs kind=readindex_fallback",
+        ("member", "kind"),
     ))
 
 

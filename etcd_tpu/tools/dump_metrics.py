@@ -159,6 +159,14 @@ def dump_local(names_only: bool = False) -> int:
     btel.shm_frames_counter()
     btel.shm_copy_bytes_counter()
     btel.shm_ring_full_counter()
+    # Device apply-plane families (ISSUE 19): KV slot occupancy,
+    # lease/watch census, and the lease-hit vs ReadIndex-fallback
+    # read split the read-mix SLO row reports.
+    btel.apply_plane_slots_gauge()
+    btel.apply_plane_leases_gauge()
+    btel.apply_plane_overflow_gauge()
+    btel.apply_plane_watch_events_counter()
+    btel.apply_plane_reads_counter()
     # Fleet observatory families (ISSUE 10): histograms + censuses +
     # anomaly counters fed from the device SummaryFrame; --watch picks
     # their deltas up like any other series once a member moves them.

@@ -35,6 +35,14 @@ the same-day inline baseline land in BENCH_NOTES and the
 whose local fsync is microsecond-class — the pipeline overlaps IO
 wait, so a free fsync leaves nothing to win.
 
+``--apply-plane`` flies the workers with the device-resident apply
+plane (ISSUE 19: tensorized KV + leader leases); ``--read-mix 0.9``
+converts that fraction of each member's ops into linearizable reads
+and records a ``reads`` block (merged read percentiles plus the
+lease-hit vs ReadIndex-fallback split). With ``--trace`` the SLO
+table additionally carries a ``read_hop`` row with the same split —
+the apply plane's headline is leased reads taking ZERO quorum hops.
+
 Run:  python -m etcd_tpu.tools.hosted_bench [--groups 1024] [--n 3000]
 """
 
@@ -65,7 +73,7 @@ def free_ports(n):
 
 def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0,
           trace=0, wal_pipeline=False, fabric="tcp", shm_dir=None,
-          pin_cores=False):
+          pin_cores=False, apply_plane=False):
     peers = [
         f"--peer={pid}=127.0.0.1:{raft_ports[pid]}"
         for pid in range(1, MEMBERS + 1) if pid != mid
@@ -97,6 +105,7 @@ def spawn(mid, raft_ports, admin_ports, data_dir, groups, gen=0,
             "--tick-interval", "0.1",
         ] + (["--trace"] if trace else [])
         + (["--wal-pipeline"] if wal_pipeline else [])
+        + (["--apply-plane"] if apply_plane else [])
         + (["--fabric", fabric] if fabric != "tcp" else [])
         + (["--shm-dir", shm_dir] if fabric == "shm" else [])
         # One pinned core per member: member i on core (i-1) mod ncpu.
@@ -145,7 +154,20 @@ def main() -> None:
     ap.add_argument("--pin-cores", action="store_true",
                     help="pin member i to core (i-1) mod ncpu — the "
                          "one-core-per-member multi-core shape")
+    ap.add_argument("--apply-plane", action="store_true",
+                    help="run the workers with the device-resident "
+                         "apply plane (ISSUE 19): tensorized KV + "
+                         "leader leases; lease-held linearizable "
+                         "reads skip the ReadIndex quorum round")
+    ap.add_argument("--read-mix", type=float, default=0.0,
+                    metavar="FRAC",
+                    help="fraction of each member's ops issued as "
+                         "linearizable reads (e.g. 0.9); the SLO "
+                         "table gains a read-hop row splitting "
+                         "lease-hit vs ReadIndex-fallback")
     args = ap.parse_args()
+    if not 0.0 <= args.read_mix <= 1.0:
+        ap.error("--read-mix must be in [0, 1]")
     # Slow-disk emulation label (native/walog.py): a bench flown with
     # ETCD_TPU_FSYNC_DELAY_MS set must say so in its artifact config.
     fsync_delay = os.environ.get("ETCD_TPU_FSYNC_DELAY_MS", "")
@@ -168,7 +190,8 @@ def main() -> None:
                                args.groups, trace=args.trace,
                                wal_pipeline=args.wal_pipeline,
                                fabric=args.fabric, shm_dir=shm_dir,
-                               pin_cores=args.pin_cores)
+                               pin_cores=args.pin_cores,
+                               apply_plane=args.apply_plane)
         for mid in range(1, MEMBERS + 1):
             clients[mid] = wait_admin(("127.0.0.1", admin_p[mid]),
                                       timeout=300.0)
@@ -231,7 +254,8 @@ def main() -> None:
             try:
                 return bc.call(op="bench", n=per,
                                value_size=args.value_size,
-                               inflight=args.inflight)
+                               inflight=args.inflight,
+                               read_mix=args.read_mix)
             finally:
                 bc.close()
 
@@ -284,6 +308,29 @@ def main() -> None:
                        if merged else 0.0),
             "per_member": parts,
         }
+        # Read-mix lane (ISSUE 19): merged read percentiles from the
+        # union of samples (same rule as writes) plus the lease-hit /
+        # ReadIndex-fallback split — the apply plane's headline is the
+        # hit ratio, not just the latency.
+        if args.read_mix > 0:
+            rmerged = sorted(
+                x for p in parts for x in p.pop("read_lat_ms_samples", []))
+            hits = sum(p.get("lease_hits", 0) for p in parts)
+            falls = sum(p.get("lease_fallbacks", 0) for p in parts)
+            bench["reads"] = {
+                "n": sum(p.get("reads", 0) for p in parts),
+                "completed": sum(p.get("reads_completed", 0)
+                                 for p in parts),
+                "lost": sum(p.get("reads_lost", 0) for p in parts),
+                "reads_per_sec": round(
+                    sum(p.get("reads_per_sec", 0.0) for p in parts), 1),
+                "p50_ms": rmerged[len(rmerged) // 2] if rmerged else 0.0,
+                "p99_ms": (rmerged[max(int(len(rmerged) * 0.99) - 1, 0)]
+                           if rmerged else 0.0),
+                "lease_hits": hits,
+                "lease_fallbacks": falls,
+                "lease_hit_ratio": round(hits / max(hits + falls, 1), 4),
+            }
 
         # SLO table (--trace): pull every member's span ring over the
         # admin 'trace' op and join them in-process — per-hop p50/p99
@@ -317,13 +364,33 @@ def main() -> None:
                 # conditions, so grafting it into an untraced headline
                 # artifact (traced runs are never the headline — the
                 # sampling cost is real) keeps the provenance visible.
+                # Read hop (ISSUE 19): the client-observed
+                # linearizable-read latency next to the traced write
+                # hops, with the lease-hit vs ReadIndex-fallback split
+                # counted separately. Kept OUT of slo["hops"] — those
+                # rows telescope to the write e2e; this one doesn't.
+                if args.read_mix > 0 and "reads" in bench:
+                    r = bench["reads"]
+                    slo["read_hop"] = {
+                        "n": r["completed"],
+                        "p50_ms": r["p50_ms"],
+                        "p99_ms": r["p99_ms"],
+                        "lease_hit": r["lease_hits"],
+                        "readindex_fallback": r["lease_fallbacks"],
+                        "lease_hit_ratio": r["lease_hit_ratio"],
+                    }
                 slo["config"] = (f"G={args.groups} R={MEMBERS} "
                                  f"value={args.value_size}B "
                                  f"inflight={args.inflight}/group CPU "
                                  f"fabric={args.fabric} "
                                  f"trace=1/{args.trace}"
                                  + (" walpipe=on" if args.wal_pipeline
-                                    else "") + delay_tag)
+                                    else "")
+                                 + (" applyplane=on" if args.apply_plane
+                                    else "")
+                                 + (f" read_mix={args.read_mix:g}"
+                                    if args.read_mix > 0 else "")
+                                 + delay_tag)
                 slo["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
                 print(f"slo: {json.dumps(slo['hops'])}",
                       file=sys.stderr)
@@ -341,7 +408,8 @@ def main() -> None:
                          gen=1, trace=args.trace,
                          wal_pipeline=args.wal_pipeline,
                          fabric=args.fabric, shm_dir=shm_dir,
-                         pin_cores=args.pin_cores)
+                         pin_cores=args.pin_cores,
+                         apply_plane=args.apply_plane)
         clients[3] = wait_admin(("127.0.0.1", admin_p[3]), timeout=300.0)
         while time.monotonic() - t0 < 180.0:
             if clients[3].get(g, b"catchup") == b"1":
@@ -370,9 +438,14 @@ def main() -> None:
                        + (f" trace=1/{args.trace}" if args.trace
                           else "")
                        + (" walpipe=on" if args.wal_pipeline else "")
+                       + (" applyplane=on" if args.apply_plane else "")
+                       + (f" read_mix={args.read_mix:g}"
+                          if args.read_mix > 0 else "")
                        + delay_tag),
             "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }
+        if "reads" in bench:
+            result["reads"] = bench["reads"]
         if slo is not None:
             result["slo"] = slo
         with open(out_path, "w") as f:

@@ -65,6 +65,20 @@ os.environ.setdefault("ETCD_TPU_TRANSFER_GUARD", "disallow")
 # the invariant-sweep ring_over_window bit + fleet-frame ring fields
 # changed layout VALUES inside existing programs, not program COUNT.
 # The G=1024 lifecycle soak config is slow-marked (outside tier-1).
+#
+# ISSUE 19 AUDIT: still 43. The device apply plane is a SEPARATE
+# jitted program with its own compile-key kind ("apply_plane": the
+# dispatch per (C, WS, A, n) plus the snapshot gather per batch
+# width — counted there, never here), and make_step_round keys
+# step._step_round_jit on cfg.apply_plane_key(), which strips every
+# apply_* knob to defaults BEFORE keying: apply_plane=True therefore
+# shares the plane-off round program STRUCTURALLY, not by luck
+# (test_applyplane asserts zero new round-step keys across a full
+# plane-on drive). The unconditional lease tick lane + the
+# lease_on_nonleader invariant bit changed program CONTENT inside
+# every existing key, not key COUNT; test_applyplane's engine pair
+# reuses test_fleet's CFG_OFF values and its hosted/chaos cells
+# reuse test_chaos.CFG values verbatim.
 ROUND_STEP_SHAPE_BUDGET = 43
 
 

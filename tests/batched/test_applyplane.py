@@ -1,0 +1,309 @@
+"""Device apply plane (ISSUE 19): static-plane bit-parity, exact
+shadow-oracle reconciliation of the tensorized MVCC dispatch, lease
+read linearizability under leadership transfer, and a quick chaos
+cell with the plane folding every commit.
+
+Compile discipline: the plane is a SEPARATE jitted program with its
+own ``apply_plane`` compile-key kind, and make_step_round keys the
+round program on ``cfg.apply_plane_key()`` (every apply_* knob
+stripped to defaults), so plane-on configs share the plane-off round
+program STRUCTURALLY — asserted below by counting round-step keys
+across the on-engine's whole drive. The engine pair reuses
+test_fleet's CFG_OFF values and the hosted/chaos cells reuse
+test_chaos.CFG values verbatim: zero new round-step programs
+(tests/batched/conftest.py ROUND_STEP_SHAPE_BUDGET stays 43).
+"""
+
+import json
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from etcd_tpu.analysis import sentinels
+from etcd_tpu.batched import MultiRaftEngine
+from etcd_tpu.batched.applyplane import (
+    OP_DEL,
+    OP_NONE,
+    OP_PUT,
+    PlaneOracle,
+    delete_payload,
+    fnv1a32,
+    init_plane,
+    make_dispatch,
+    parse_payload,
+    put_payload,
+)
+from etcd_tpu.batched.faults import (
+    ChaosHarness,
+    LeaderObserver,
+    run_invariant_checks,
+)
+from etcd_tpu.batched.hosting import (
+    MultiRaftCluster,
+    NotLeaderError,
+    _split_snap_blob,
+)
+
+from .test_chaos import CFG, G, MSG_FAULTS, R, SEEDS
+from .test_fleet import CFG_OFF, drive
+
+# The hosted/chaos config: test_chaos.CFG + the plane. apply_plane_key
+# normalization makes this the SAME round-step compile key as CFG.
+CFG_PLANE = CFG._replace(apply_plane=True, apply_capacity=64,
+                         apply_watch_slots=4, apply_records=4)
+
+# The engine-parity config: test_fleet's CFG_OFF + the plane.
+CFG_AP_ON = CFG_OFF._replace(apply_plane=True, apply_capacity=32,
+                             apply_watch_slots=4, apply_records=4)
+
+
+# -----------------------------------------------------------------------------
+# Payload forms + snapshot blob discrimination (pure host)
+# -----------------------------------------------------------------------------
+
+
+def test_payload_roundtrip():
+    assert parse_payload(put_payload(b"k", b"v")) == (OP_PUT, b"k", b"v", 0)
+    assert parse_payload(put_payload(b"k", b"v", lease_ttl=7)) == (
+        OP_PUT, b"k", b"v", 7)
+    assert parse_payload(delete_payload(b"k")) == (OP_DEL, b"k", b"", 0)
+    # The non-lease forms are byte-identical to the pre-plane wire
+    # format — every existing WAL/snapshot stays replayable.
+    assert put_payload(b"k", b"v") == b"Pk\x00v"
+    assert delete_payload(b"k") == b"Dk"
+    assert parse_payload(b"") is None
+    assert parse_payload(b"E\x00") is None  # truncated TTL
+
+
+def test_snap_blob_two_tier_discrimination():
+    """Legacy flat hex blobs and the two-tier host+plane wrapper must
+    both restore; hex keys can never collide with the wrapper keys."""
+    legacy = json.dumps({b"k".hex(): b"v".hex()}).encode()
+    data, img = _split_snap_blob(legacy)
+    assert data == {b"k": b"v"} and img is None
+    two = json.dumps({"host": {b"k".hex(): b"v".hex()},
+                      "plane": {"rev": 3}}).encode()
+    data, img = _split_snap_blob(two)
+    assert data == {b"k": b"v"} and img == {"rev": 3}
+    assert _split_snap_blob(b"") == ({}, None)
+
+
+# -----------------------------------------------------------------------------
+# Static-plane contract: bit-identical protocol state, zero new
+# round-step programs
+# -----------------------------------------------------------------------------
+
+
+def test_protocol_state_bit_identical_on_off():
+    """Acceptance: apply_plane=True must not change a single bit of
+    protocol state (or the routed inbox) vs apply_plane=False, serial
+    and pipelined — and must not build a single new round-step
+    program (the structural apply_plane_key guarantee)."""
+    assert CFG_AP_ON.apply_plane_key() == CFG_OFF.apply_plane_key()
+    a = MultiRaftEngine(CFG_OFF)
+    keys_before = set(sentinels.compile_keys("round_step"))
+    b = MultiRaftEngine(CFG_AP_ON)
+
+    def compare(loop):
+        for field in a.state._fields:
+            av = np.asarray(getattr(a.state, field))
+            bv = np.asarray(getattr(b.state, field))
+            assert np.array_equal(av, bv), (
+                f"state field {field} diverged with the plane on "
+                f"({loop})")
+        for field in a.inbox._fields:
+            av = np.asarray(getattr(a.inbox, field))
+            bv = np.asarray(getattr(b.inbox, field))
+            assert np.array_equal(av, bv), (
+                f"inbox field {field} diverged ({loop})")
+
+    drive(a, False)
+    drive(b, False)
+    compare("serial")
+    drive(a, True)
+    drive(b, True)
+    compare("pipelined")
+    new = set(sentinels.compile_keys("round_step")) - keys_before
+    assert not new, (
+        f"apply_plane=True forked the round-step program: {new}")
+
+
+# -----------------------------------------------------------------------------
+# Device dispatch vs the host oracle — exact, not statistical
+# -----------------------------------------------------------------------------
+
+
+def test_device_plane_reconciles_with_oracle():
+    """Seeded mixed workload (puts, deletes, TTL'd puts, re-puts, an
+    overflowing row, armed watches, uneven tick streams) folded by the
+    device dispatch must match the pure-Python oracle BIT-FOR-BIT:
+    every KV/rev/lease slot, the revision and tick counters, the
+    sticky overflow flag, the slot high-water, and every emitted
+    watch-bitmap event."""
+    n, a_rec = 4, 4
+    cfg = CFG._replace(apply_plane=True, apply_capacity=16,
+                       apply_watch_slots=4, apply_records=a_rec)
+    dispatch = make_dispatch(cfg, n)
+    plane = init_plane(cfg, n)
+    oracles = [PlaneOracle(cfg) for _ in range(n)]
+
+    # Key pools: row 0 draws from 40 distinct keys against capacity 16
+    # so it MUST overflow; the rest stay within capacity.
+    pools = [[fnv1a32(b"r%d-k%d" % (r, i))
+              for i in range(40 if r == 0 else 10)] for r in range(n)]
+    # Armed watches: two keys per row (slot 0, 2).
+    wk = np.zeros((n, cfg.apply_watch_slots), np.int32)
+    for r in range(n):
+        wk[r, 0] = pools[r][0]
+        wk[r, 2] = pools[r][1]
+        oracles[r].watch_key[0] = pools[r][0]
+        oracles[r].watch_key[2] = pools[r][1]
+    plane = plane._replace(watch_key=jnp.asarray(wk))
+
+    rng = np.random.default_rng(7)
+    frames = []
+    for _ in range(25):
+        ops = np.zeros((n, a_rec), np.int32)
+        keys = np.zeros((n, a_rec), np.int32)
+        vals = np.zeros((n, a_rec), np.int32)
+        ttls = np.zeros((n, a_rec), np.int32)
+        tick_add = rng.integers(0, 3, size=n).astype(np.int32)
+        for r in range(n):
+            k = int(rng.integers(0, a_rec + 1))
+            recs = []
+            for j in range(k):
+                op = OP_PUT if rng.random() < 0.7 else OP_DEL
+                key = int(rng.choice(pools[r]))
+                val = fnv1a32(rng.bytes(4)) if op == OP_PUT else 0
+                ttl = (int(rng.integers(1, 6))
+                       if op == OP_PUT and rng.random() < 0.3 else 0)
+                ops[r, j], keys[r, j] = op, key
+                vals[r, j], ttls[r, j] = val, ttl
+                recs.append((op, key, val, ttl))
+            # Oracle sees the identical record stream (OP_NONE padding
+            # is a no-op on both sides).
+            recs += [(OP_NONE, 0, 0, 0)] * (a_rec - k)
+            oracles[r].dispatch(recs, int(tick_add[r]))
+        plane, frame = dispatch(
+            plane, jnp.asarray(ops), jnp.asarray(keys),
+            jnp.asarray(vals), jnp.asarray(ttls), jnp.asarray(tick_add))
+        frames.append(frame)
+
+    for r in range(n):
+        o = oracles[r]
+        for name, dev in (("kv_key", plane.kv_key),
+                          ("kv_rev", plane.kv_rev),
+                          ("kv_val", plane.kv_val),
+                          ("kv_lease", plane.kv_lease)):
+            assert np.asarray(dev)[r].tolist() == getattr(o, name), (
+                f"row {r} {name} diverged from the oracle")
+        assert int(plane.rev[r]) == o.rev
+        assert int(plane.tick[r]) == o.tick
+        assert bool(plane.overflow[r]) == o.overflow
+        assert int(plane.slots_hw[r]) == o.slots_hw
+        # Event stream: device lanes with op != 0, in dispatch order.
+        dev_evs = []
+        for fr in frames:
+            for j in range(a_rec):
+                if int(fr.ev_op[r, j]) != OP_NONE:
+                    dev_evs.append((int(fr.ev_op[r, j]),
+                                    int(fr.ev_key[r, j]),
+                                    int(fr.ev_rev[r, j]),
+                                    int(fr.ev_wmask[r, j])))
+        assert dev_evs == o.events, f"row {r} event stream diverged"
+        assert sum(int(fr.expired[r]) for fr in frames) == o.expired
+    assert bool(plane.overflow[0]), (
+        "row 0 drew 40 keys against capacity 16 and never overflowed")
+    assert any(o.events and any(e[3] for e in o.events)
+               for o in oracles), "no watch bitmap ever matched"
+
+
+# -----------------------------------------------------------------------------
+# Hosted: lease reads are linearizable under leadership transfer
+# -----------------------------------------------------------------------------
+
+
+def _lin_read(cl, g, key, timeout=60.0):
+    """Redirect-style client read (the documented pattern): try every
+    member, retrying on NotLeaderError/TimeoutError."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        for m in cl.members.values():
+            try:
+                return m.linearizable_get(g, key, timeout=5.0)
+            except (NotLeaderError, TimeoutError):
+                continue
+        time.sleep(0.05)
+    raise TimeoutError(f"no member served the read for group {g}")
+
+
+def test_lease_read_linearizable_under_transfer(tmp_path):
+    """Acceptance: a lease-holding leader serves linearizable reads
+    with zero quorum rounds; a member that just STAGED a leadership
+    transfer must fall back to ReadIndex (or refuse) — and must never
+    serve a value older than one written through the new leader."""
+    cl = MultiRaftCluster(str(tmp_path), num_members=R, num_groups=G,
+                          cfg=CFG_PLANE)
+    try:
+        cl.wait_leaders(timeout=120.0)
+        cl.put(0, b"k", b"v1", timeout=30.0)
+        assert _lin_read(cl, 0, b"k") == b"v1"
+        hits = sum(m.stats.get("lease_read_hits", 0)
+                   for m in cl.members.values())
+        assert hits >= 1, "steady-leader read never took the lease path"
+
+        old = next(m for m in cl.members.values() if m.is_leader(0))
+        target = (old.id % R) + 1
+        assert old.transfer_leader(0, target), "transfer failed"
+        # Write THROUGH the cluster (routed to whichever member leads
+        # now), then read at the old leader: the lease block + device
+        # lease zeroing must force ReadIndex/refusal — a stale b"v1"
+        # here would be the linearizability violation the lease
+        # machinery exists to prevent.
+        cl.put(0, b"k", b"v2", timeout=30.0)
+        try:
+            got = old.linearizable_get(0, b"k", timeout=5.0)
+            assert got == b"v2", f"stale read after transfer: {got!r}"
+        except (NotLeaderError, TimeoutError):
+            pass  # refusing is linearizable too
+        falls = sum(m.stats.get("lease_read_fallbacks", 0)
+                    for m in cl.members.values())
+        assert falls >= 1 or not old.is_leader(0), (
+            "old leader neither fell back nor stepped down")
+        assert _lin_read(cl, 0, b"k") == b"v2"
+    finally:
+        cl.stop()
+
+
+# -----------------------------------------------------------------------------
+# Chaos: the plane rides a faulty episode with strict checkers
+# -----------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_quick_with_plane(tmp_path):
+    """One quick chaos cell on the shared chaos CFG with the plane
+    folding every commit: lossy links, a kill mid-flight, restart
+    through _replay (exercising the plane's snapshot/boot reseeding),
+    then the strict 3-checker close — which also asserts the
+    on-device invariant sweep (now including lease_on_nonleader)
+    stayed at zero trips."""
+    h = ChaosHarness(str(tmp_path), SEEDS[0], MSG_FAULTS,
+                     num_members=R, num_groups=G, cfg=CFG_PLANE)
+    obs = LeaderObserver(h.alive)
+    try:
+        h.wait_leaders()
+        obs.start()
+        acked = h.run_workload(12)
+        assert acked >= 6, f"only {acked}/12 writes acked"
+        h.crash(2)
+        h.restart(2)
+        h.wait_leaders()
+        h.run_workload(4, prefix=b"post")
+        h.plan.quiesce()
+        run_invariant_checks(h, obs, expect_members=R)
+    finally:
+        obs.stop()
+        h.stop()

@@ -355,6 +355,8 @@ class TestInvariantBit:
         st.log_term = jnp.zeros((4,), jnp.int32)
         st.read_ready = jnp.asarray(False)
         st.read_index = jnp.asarray(0, jnp.int32)
+        # Bit 11 (lease_on_nonleader) reads the leader-lease tick lane.
+        st.lease_ticks = jnp.asarray(0, jnp.int32)
         slot = jnp.asarray(0, jnp.int32)
         assert int(invariant_bits(st, slot)) == 0
 
@@ -365,6 +367,13 @@ class TestInvariantBit:
         st.in_joint = jnp.asarray(True)
         assert int(invariant_bits(st, slot)) == 0
         assert "voter_out_no_joint" in INV_NAMES
+
+        # Lease residue on a non-leader (role 0 here) is a stale read
+        # authorization and must trip its own bit.
+        st.lease_ticks = jnp.asarray(3, jnp.int32)
+        assert decode_invariants(int(invariant_bits(st, slot))) == [
+            "lease_on_nonleader"]
+        assert "lease_on_nonleader" in INV_NAMES
 
 
 class TestConfStoreSemantics:

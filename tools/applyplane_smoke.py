@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Device apply-plane smoke for tools/check.sh (ISSUE 19): a tiny
+in-proc cluster runs with ``apply_plane=True`` (tensorized KV +
+revision lanes, watch compare lanes, lease ticks) and drives the whole
+surface once: mixed puts land in both tiers, a lease-held linearizable
+read serves from the leader with ZERO quorum rounds (counted as a
+lease hit), an armed watch slot emits a fixed-shape event frame with
+the right revision, a TTL'd put expires on the plane clock and the
+masked read stops serving it, and a leadership transfer forces the
+read path back to ReadIndex (counted as a fallback — never a stale
+serve). One tiny compile (~seconds on CPU); a lease-safety, watch
+or routing regression fails the static gate, not a hosted run.
+
+Writes artifacts/applyplane_smoke.json (uploaded by lint.yml on
+failure).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from etcd_tpu.batched.hosting import (  # noqa: E402
+    MultiRaftCluster, NotLeaderError)
+from etcd_tpu.batched.state import BatchedConfig  # noqa: E402
+
+G, R = 4, 3
+
+OUT = os.path.join("artifacts", "applyplane_smoke.json")
+
+
+def _write(report) -> None:
+    os.makedirs("artifacts", exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+
+
+def _fail(report, msg: str) -> int:
+    report["ok"] = False
+    report["error"] = msg
+    _write(report)
+    print(f"applyplane smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def lin_read(cl, g, key, timeout=30.0):
+    """Redirect-style client read: try every member, retrying on
+    NotLeaderError/TimeoutError — leadership placement is the
+    cluster's business, not the client's."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        for m in cl.members.values():
+            try:
+                return m, m.linearizable_get(g, key, timeout=5.0)
+            except (NotLeaderError, TimeoutError):
+                continue
+        time.sleep(0.05)
+    raise TimeoutError(f"no member served the read for group {g}")
+
+
+def main() -> int:
+    cfg = BatchedConfig(
+        num_groups=G, num_replicas=R, window=16, max_ents_per_msg=4,
+        max_props_per_round=4, election_timeout=10,
+        heartbeat_timeout=1, pre_vote=True, check_quorum=True,
+        auto_compact=True,
+        apply_plane=True, apply_capacity=64, apply_watch_slots=4,
+        apply_records=4,
+    )
+    report = {"groups": G, "members": R, "ok": False,
+              "capacity": cfg.apply_capacity,
+              "watch_slots": cfg.apply_watch_slots}
+    with tempfile.TemporaryDirectory(prefix="applyplane-smoke-") as d:
+        cl = MultiRaftCluster(d, num_members=R, num_groups=G, cfg=cfg)
+        try:
+            cl.wait_leaders(timeout=120.0)
+
+            # Watches are member-local: arm a slot on member 1 before
+            # the write so the apply dispatch sees the armed compare.
+            wm = cl.members[1]
+            wm.watch(0, b"wk")
+
+            # Mixed workload: plain puts, the watched key, a TTL'd put.
+            for i in range(6):
+                cl.put(0, b"k%d" % i, b"v%d" % i, timeout=30.0)
+            cl.put(0, b"wk", b"wv", timeout=30.0)
+            cl.put(1, b"lk", b"lv", lease_ttl=8, timeout=30.0)
+
+            # Lease-held linearizable read: the steady leader serves
+            # from the applied host tier under its lease — zero quorum
+            # rounds, counted as a lease hit.
+            m0, v = lin_read(cl, 0, b"k3")
+            if v != b"v3":
+                return _fail(report, f"lease read returned {v!r}")
+            hits = sum(m.stats.get("lease_read_hits", 0)
+                       for m in cl.members.values())
+            if hits < 1:
+                return _fail(report, "no lease-hit read counted")
+            report["apply_plane_health"] = m0.health()["apply_plane"]
+
+            # Watch frame: the armed slot must emit a PUT event with
+            # the key's hash and a sane revision.
+            deadline = time.monotonic() + 10.0
+            evs = []
+            while time.monotonic() < deadline and not evs:
+                evs = wm.watch_events()
+                time.sleep(0.05)
+            hit = [e for e in evs
+                   if e["key"] == b"wk".hex() and e["op"] == "PUT"]
+            if not hit:
+                return _fail(report, f"watch event missing: {evs}")
+            report["watch_event"] = hit[0]
+
+            # Lease expiry: the plane tick lane passes the TTL and the
+            # masked read stops serving the key (host bytes remain —
+            # cross-member byte parity is not disturbed).
+            def masked():
+                for m in cl.members.values():
+                    if m.is_leader(1):
+                        return m._lease_masked_get(1, b"lk")
+                return b"<noleader>"
+
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and masked() is not None:
+                time.sleep(0.1)
+            if masked() is not None:
+                return _fail(report, "TTL'd key never expired on the "
+                             "plane clock")
+
+            # Transfer: the departing leader must FALL BACK to
+            # ReadIndex (or refuse), never serve a stale lease read.
+            old = next(m for m in cl.members.values()
+                       if m.is_leader(2))
+            target = (old.id % R) + 1
+            if not old.transfer_leader(2, target):
+                return _fail(report, "leadership transfer failed")
+            try:
+                old.linearizable_get(2, b"x", timeout=3.0)
+            except (NotLeaderError, TimeoutError):
+                pass
+            if old.stats.get("lease_read_fallbacks", 0) < 1:
+                return _fail(report, "post-transfer read did not fall "
+                             "back to ReadIndex")
+            report["post_transfer_fallbacks"] = (
+                old.stats.get("lease_read_fallbacks", 0))
+            report["lease_read_hits_total"] = sum(
+                m.stats.get("lease_read_hits", 0)
+                for m in cl.members.values())
+        finally:
+            cl.stop()
+
+    report["ok"] = True
+    _write(report)
+    h = report["apply_plane_health"]
+    print(f"applyplane smoke OK: kv hw {h['slots_high_water']}/"
+          f"{h['capacity']}, leases {h['active_leases']}, "
+          f"lease hits {report['lease_read_hits_total']}, "
+          f"watch rev {report['watch_event']['rev']}, "
+          f"transfer fallbacks {report['post_transfer_fallbacks']} "
+          f"({OUT})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

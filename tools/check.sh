@@ -12,6 +12,8 @@
 # cluster, put wave, console transport column + shm metric families)
 # + lifecycle smoke (ISSUE 17 log-lifecycle plane: rotation, cadence
 # snapshots, fleet-min release, restart replay from snapshot files)
+# + applyplane smoke (ISSUE 19 device apply plane: lease-hit read,
+# watch frame, TTL expiry on the plane clock, transfer fallback)
 # + bench-history re-emit. CI
 # runs exactly this script
 # (.github/workflows/lint.yml); run it locally before pushing anything
@@ -58,6 +60,9 @@ python tools/shmfabric_smoke.py
 
 echo "== lifecycle smoke (WAL rotation -> cadence snapshot -> release -> replay) =="
 python tools/lifecycle_smoke.py
+
+echo "== applyplane smoke (lease-hit read, watch frame, TTL expiry, transfer fallback) =="
+python tools/applyplane_smoke.py
 
 echo "== bench history (artifacts/bench_history.json + BENCH_HISTORY.md) =="
 python tools/bench_history.py

@@ -144,6 +144,13 @@ def collect(addrs: List[str], timeout: float = 10.0,
             "lifecycle": (hl.get("lifecycle")
                           if hl.get("ok") else None),
             "ring": hl.get("ring") if hl.get("ok") else None,
+            # Device apply plane (ISSUE 19): KV slot high-water vs
+            # capacity, active lease census, watch-event total, and
+            # the lease-read hit/fallback split from the health op.
+            # None when the member predates the field,
+            # {"enabled": False} when the plane is off.
+            "apply_plane": (hl.get("apply_plane")
+                            if hl.get("ok") else None),
         })
         members[mid] = ent
 
@@ -203,6 +210,18 @@ def collect(addrs: List[str], timeout: float = 10.0,
             if (m.get("lifecycle") or {}).get("wal_pinned")),
         "top": merged_top,
         "anomalies": anomalies,
+        # Apply-plane rollup (ISSUE 19): leases are leader-local (one
+        # holder per led group), so summing across members is the true
+        # cluster census; the hit ratio pools every member's reads.
+        "active_leases_total": sum(
+            (m.get("apply_plane") or {}).get("active_leases", 0)
+            for m in live),
+        "lease_read_hits_total": sum(
+            (m.get("apply_plane") or {}).get("lease_read_hits", 0)
+            for m in live),
+        "lease_read_fallbacks_total": sum(
+            (m.get("apply_plane") or {}).get("lease_read_fallbacks", 0)
+            for m in live),
     }
     return {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "members": members, "cluster": cluster}
@@ -247,6 +266,7 @@ def render(data: Dict, top: int = 8) -> str:
         f"groups {cl['groups']}  leaders {cl['leaders_total']}  "
         f"fenced {cl['fenced_total']}  "
         f"joint {cl['joint_total']}  learners {cl['learners_total']}  "
+        f"leases {cl.get('active_leases_total', 0)}  "
         f"inv-trips "
         f"{'n/a' if cl['invariant_trips_total'] is None else cl['invariant_trips_total']}  "
         f"router-loss {cl['router_loss_total']}",
@@ -255,7 +275,8 @@ def render(data: Dict, top: int = 8) -> str:
         f"{'joint':>6} {'lrnr':>5} "
         f"{'lag max':>8} {'inv':>5} {'loss':>6} {'r/fsync':>8} "
         f"{'fsync ms':>9} {'wal seg/MiB':>12} {'snaps':>6} "
-        f"{'ring hw':>8} {'transport':>14}  wal tail / disk state",
+        f"{'ring hw':>8} {'kv hw':>9} {'leases':>7} {'watch ev':>9} "
+        f"{'rd hit':>7} {'transport':>14}  wal tail / disk state",
     ]
     for mid in sorted(data["members"]):
         m = data["members"][mid]
@@ -290,6 +311,24 @@ def render(data: Dict, top: int = 8) -> str:
             seg, snaps = "-", "-"
         ring_hw = (f"{ring.get('occ_high_water', 0)}/"
                    f"{ring.get('window', 0)}" if ring else "-")
+        # Apply-plane columns (ISSUE 19): KV slot high-water vs
+        # capacity, active leases, watch events delivered, and the
+        # lease-read hit ratio. "-" when the plane is off or the
+        # member predates it.
+        ap = m.get("apply_plane") or {}
+        if ap.get("enabled"):
+            kv_hw = (f"{ap.get('slots_high_water', 0)}/"
+                     f"{ap.get('capacity', 0)}")
+            if ap.get("overflow_rows", 0):
+                kv_hw += "!"
+            leases = str(ap.get("active_leases", 0))
+            wev = str(ap.get("watch_events", 0))
+            reads = (ap.get("lease_read_hits", 0)
+                     + ap.get("lease_read_fallbacks", 0))
+            rd_hit = (f"{ap.get('lease_read_hits', 0) / reads:.2f}"
+                      if reads else "-")
+        else:
+            kv_hw, leases, wev, rd_hit = "-", "-", "-", "-"
         # The disk-state tail: wal tail classification, plus any live
         # fault-plane condition (limping / disk_full / fail-stop /
         # a pinned WAL backlog and the group pinning it).
@@ -310,6 +349,7 @@ def render(data: Dict, top: int = 8) -> str:
             f"{str(m['invariant_trips']):>5} "
             f"{str(m['router_loss']):>6} {rpf:>8} {fsync_ms:>9} "
             f"{seg:>12} {snaps:>6} {ring_hw:>8} "
+            f"{kv_hw:>9} {leases:>7} {wev:>9} {rd_hit:>7} "
             f"{fab:>14}  {disk}")
     lines.append("")
     lines.append(f"top-{top} laggards (cluster-wide):")
